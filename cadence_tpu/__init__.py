@@ -1,0 +1,23 @@
+"""cadence-tpu: a TPU-native, durable workflow-orchestration framework.
+
+A ground-up rebuild of the capabilities of Uber Cadence (reference at
+/root/reference) designed TPU-first: workflow-history replay — which the
+reference executes as a sequential per-workflow Go loop
+(service/history/stateBuilder.go:112-613) — is batched finite-state-machine
+simulation here: the event-type × state transition function is a vectorized
+JAX kernel (`cadence_tpu.ops.replay`) that replays thousands of histories per
+`lax.scan`/`pjit` step, behind the same replay interfaces the reference
+exposes (`StateBuilder.apply_events`, `StateRebuilder.rebuild`).
+
+Layers (mirrors SURVEY.md §1 of the repo):
+  core/      event/state schema, the workflow FSM (MutableState), the host
+             oracle replayer, history builder, task generation
+  ops/       dense tensor encodings + the batched TPU replay kernel
+  parallel/  device-mesh sharding of replay, NDC snapshot collectives
+  runtime/   host control plane: persistence, shards, history engine,
+             matching, frontend, queue processors, replication
+  models/    workflow program model + canary-equivalent workloads
+  utils/     hashing, clock, backoff, dynamic config, metrics, logging
+"""
+
+__version__ = "0.1.0"

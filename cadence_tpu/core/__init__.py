@@ -1,0 +1,1 @@
+"""Core workflow FSM: events, mutable state, replay oracle, task generation."""

@@ -1,0 +1,24 @@
+"""Test harness config: force an 8-device virtual CPU mesh before jax loads.
+
+Mirrors the reference's onebox strategy (multi-"node" testing without a real
+cluster, /root/reference/host/onebox.go) at the device level: multi-chip
+sharding is validated on virtual CPU devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax_devices():
+    import jax
+
+    return jax.devices()

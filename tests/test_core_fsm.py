@@ -1,0 +1,260 @@
+"""Unit tests for MutableState transitions + the host StateBuilder oracle.
+
+Modeled on the reference's stateBuilder_test.go table of per-event-type
+replay assertions (/root/reference/service/history/stateBuilder_test.go).
+"""
+
+from cadence_tpu.core import history_factory as F
+from cadence_tpu.core.enums import (
+    CloseStatus,
+    TimeoutType,
+    TimerTaskType,
+    TransferTaskType,
+    WorkflowState,
+)
+from cadence_tpu.core.ids import EMPTY_EVENT_ID
+from cadence_tpu.core.mutable_state import MutableState, SECOND
+from cadence_tpu.core.state_builder import StateBuilder
+
+V = 1  # failover version
+T0 = 1_700_000_000 * SECOND
+
+
+def replay(history, ms=None):
+    ms = ms or MutableState(domain_id="dom")
+    sb = StateBuilder(ms, id_generator=lambda: "fixed-id")
+    last_event, last_decision, new_run = sb.apply_events(
+        "dom", "req-1", "wf-1", "run-1", history
+    )
+    return ms, sb, last_decision
+
+
+def echo_history():
+    """start → decision sched/started/completed → activity sched/started/
+    completed → decision sched/started/completed → complete (10 events)."""
+    t = T0
+    return [
+        F.workflow_execution_started(1, V, t, task_list="tl", workflow_type="echo"),
+        F.decision_task_scheduled(2, V, t + SECOND, task_list="tl"),
+        F.decision_task_started(3, V, t + 2 * SECOND, scheduled_event_id=2),
+        F.decision_task_completed(4, V, t + 3 * SECOND, scheduled_event_id=2, started_event_id=3),
+        F.activity_task_scheduled(5, V, t + 3 * SECOND, activity_id="a1",
+                                  decision_task_completed_event_id=4),
+        F.activity_task_started(6, V, t + 4 * SECOND, scheduled_event_id=5),
+        F.activity_task_completed(7, V, t + 5 * SECOND, scheduled_event_id=5, started_event_id=6),
+        F.decision_task_scheduled(8, V, t + 5 * SECOND, task_list="tl"),
+        F.decision_task_started(9, V, t + 6 * SECOND, scheduled_event_id=8),
+        F.workflow_execution_completed(10, V, t + 7 * SECOND,
+                                       decision_task_completed_event_id=9),
+    ]
+
+
+class TestEchoReplay:
+    def test_final_state(self):
+        ms, sb, _ = replay(echo_history())
+        ei = ms.execution_info
+        assert ei.workflow_id == "wf-1"
+        assert ei.run_id == "run-1"
+        assert ei.task_list == "tl"
+        assert ei.workflow_type_name == "echo"
+        assert ei.state == WorkflowState.Completed
+        assert ei.close_status == CloseStatus.Completed
+        assert ei.next_event_id == 11
+        assert ei.last_first_event_id == 1
+        assert ms.pending_activities == {}
+        assert ms.pending_timers == {}
+
+    def test_mid_replay_activity_pending(self):
+        ms, sb, _ = replay(echo_history()[:6])
+        assert 5 in ms.pending_activities
+        ai = ms.pending_activities[5]
+        assert ai.activity_id == "a1"
+        assert ai.started_id == 6
+        assert ms.execution_info.state == WorkflowState.Running
+
+    def test_transfer_tasks(self):
+        ms, sb, _ = replay(echo_history())
+        kinds = [t.task_type for t in sb.transfer_tasks]
+        assert kinds == [
+            TransferTaskType.RecordWorkflowStarted,
+            TransferTaskType.DecisionTask,
+            TransferTaskType.ActivityTask,
+            TransferTaskType.DecisionTask,
+            TransferTaskType.CloseExecution,
+        ]
+        dt = [t for t in sb.transfer_tasks if t.task_type == TransferTaskType.DecisionTask]
+        assert dt[0].schedule_id == 2 and dt[1].schedule_id == 8
+
+    def test_timer_tasks(self):
+        ms, sb, _ = replay(echo_history())
+        kinds = [t.task_type for t in sb.timer_tasks]
+        # workflow timeout, decision start-to-close ×2, activity timeout,
+        # history retention
+        assert TimerTaskType.WorkflowTimeout in kinds
+        assert kinds.count(TimerTaskType.DecisionTimeout) == 2
+        assert TimerTaskType.ActivityTimeout in kinds
+        assert TimerTaskType.DeleteHistoryEvent in kinds
+
+
+class TestDecisionFSM:
+    def test_decision_scheduled_sets_pending(self):
+        h = echo_history()[:2]
+        ms, _, last_decision = replay(h)
+        assert ms.has_pending_decision()
+        assert not ms.has_inflight_decision()
+        assert last_decision.schedule_id == 2
+        assert ms.execution_info.decision_schedule_id == 2
+
+    def test_decision_started_inflight(self):
+        ms, _, d = replay(echo_history()[:3])
+        assert ms.has_inflight_decision()
+        assert ms.execution_info.decision_started_id == 3
+        assert ms.execution_info.state == WorkflowState.Running
+
+    def test_decision_completed_clears(self):
+        ms, _, _ = replay(echo_history()[:4])
+        assert not ms.has_pending_decision()
+        assert ms.execution_info.last_processed_event == 3
+
+    def test_decision_timeout_increments_attempt(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.decision_task_scheduled(2, V, t + SECOND),
+            F.decision_task_started(3, V, t + 2 * SECOND, scheduled_event_id=2),
+            F.decision_task_timed_out(4, V, t + 20 * SECOND, scheduled_event_id=2,
+                                      started_event_id=3),
+        ]
+        ms, sb, d = replay(h)
+        # transient decision scheduled with attempt 1
+        assert ms.execution_info.decision_attempt == 1
+        assert ms.has_pending_decision()
+        assert d is not None and d.attempt == 1
+
+    def test_sticky_timeout_no_attempt_increment(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.decision_task_scheduled(2, V, t + SECOND),
+            F.decision_task_timed_out(
+                4, V, t + 20 * SECOND, scheduled_event_id=2,
+                timeout_type=TimeoutType.ScheduleToStart),
+        ]
+        ms, sb, _ = replay(h)
+        assert ms.execution_info.decision_attempt == 0
+        assert not ms.has_pending_decision()
+
+
+class TestTimers:
+    def test_timer_lifecycle(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.decision_task_scheduled(2, V, t),
+            F.decision_task_started(3, V, t, scheduled_event_id=2),
+            F.decision_task_completed(4, V, t, scheduled_event_id=2, started_event_id=3),
+            F.timer_started(5, V, t, timer_id="t1", start_to_fire_timeout_seconds=30,
+                            decision_task_completed_event_id=4),
+        ]
+        ms, sb, _ = replay(h)
+        assert "t1" in ms.pending_timers
+        ti = ms.pending_timers["t1"]
+        assert ti.started_id == 5
+        assert ti.expiry_time == t + 30 * SECOND
+        user_timers = [x for x in sb.timer_tasks if x.task_type == TimerTaskType.UserTimer]
+        assert len(user_timers) == 1
+        assert user_timers[0].visibility_timestamp == t + 30 * SECOND
+
+        h2 = h + [F.timer_fired(6, V, t + 30 * SECOND, timer_id="t1", started_event_id=5)]
+        ms2, _, _ = replay(h2)
+        assert ms2.pending_timers == {}
+
+
+class TestSignalsAndCancel:
+    def test_signal_count(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.workflow_execution_signaled(2, V, t, signal_name="s1"),
+            F.workflow_execution_signaled(3, V, t, signal_name="s2"),
+        ]
+        ms, _, _ = replay(h)
+        assert ms.execution_info.signal_count == 2
+
+    def test_cancel_requested(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.workflow_execution_cancel_requested(2, V, t),
+        ]
+        ms, _, _ = replay(h)
+        assert ms.execution_info.cancel_requested
+
+
+class TestChildren:
+    def test_child_lifecycle(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.decision_task_scheduled(2, V, t),
+            F.decision_task_started(3, V, t, scheduled_event_id=2),
+            F.decision_task_completed(4, V, t, scheduled_event_id=2, started_event_id=3),
+            F.start_child_initiated(5, V, t, domain="dom", workflow_id="child-1",
+                                    decision_task_completed_event_id=4),
+        ]
+        ms, sb, _ = replay(h)
+        assert 5 in ms.pending_children
+        assert any(
+            x.task_type == TransferTaskType.StartChildExecution
+            for x in sb.transfer_tasks
+        )
+
+        h2 = h + [
+            F.child_execution_started(6, V, t, initiated_event_id=5,
+                                      workflow_id="child-1", run_id="crun"),
+            F.child_execution_completed(7, V, t, initiated_event_id=5,
+                                        started_event_id=6),
+        ]
+        ms2, _, _ = replay(h2)
+        assert ms2.pending_children == {}
+
+
+class TestContinueAsNew:
+    def test_continue_as_new(self):
+        t = T0
+        h = [
+            F.workflow_execution_started(1, V, t),
+            F.decision_task_scheduled(2, V, t),
+            F.decision_task_started(3, V, t, scheduled_event_id=2),
+            F.decision_task_completed(4, V, t, scheduled_event_id=2, started_event_id=3),
+            F.workflow_execution_continued_as_new(
+                5, V, t, new_execution_run_id="run-2",
+                decision_task_completed_event_id=4),
+        ]
+        new_run_history = [
+            F.workflow_execution_started(1, V, t + SECOND,
+                                         continued_execution_run_id="run-1"),
+            F.decision_task_scheduled(2, V, t + SECOND),
+        ]
+        ms = MutableState(domain_id="dom")
+        sb = StateBuilder(ms, id_generator=lambda: "fixed-id")
+        _, _, new_ms = sb.apply_events(
+            "dom", "req", "wf-1", "run-1", h, new_run_history)
+        assert ms.execution_info.close_status == CloseStatus.ContinuedAsNew
+        assert new_ms is not None
+        assert new_ms.execution_info.run_id == "run-2"
+        assert new_ms.has_pending_decision()
+
+
+class TestSerialization:
+    def test_event_roundtrip(self):
+        e = F.activity_task_scheduled(
+            5, V, T0, activity_id="a1", input=b"\x00\xffbin")
+        e2 = type(e).from_json(e.to_json())
+        assert e2 == e
+
+    def test_snapshot_roundtrip(self):
+        ms, _, _ = replay(echo_history()[:6])
+        snap = ms.snapshot()
+        ms2 = MutableState.from_snapshot(snap)
+        assert ms2.snapshot() == snap
