@@ -1,0 +1,1 @@
+"""Dense tensor encodings + the batched TPU replay kernel (the north star)."""

@@ -1,0 +1,413 @@
+"""Pack workflow histories into dense event tensors for device replay.
+
+The packer is the host half of the replay-kernel contract
+(cadence_tpu/ops/replay.py). Like a tokenizer, it precomputes everything
+that is string- or hash-keyed so the device never chases pointers:
+
+  * **slot assignment**: every pending-map entry (activity / timer / child /
+    external cancel / external signal) gets a fixed slot index for its
+    lifetime; events that touch an entry carry the slot in ``EV_SLOT``.
+    Slot allocation is deterministic (lowest free slot) so replays are
+    reproducible. This mirrors the reference's map keys
+    (pendingActivityInfoIDs by schedule ID, pendingTimerInfoIDs by timer
+    ID, … mutableStateBuilder.go:68-133) without on-device hashing.
+  * **batch boundaries**: ``EV_BATCH_FIRST`` carries the first event ID of
+    each transaction batch (the reference applies history batch-at-a-time,
+    nDCStateRebuilder.go:103-137; batch structure drives
+    scheduled_event_batch_id / completion_event_batch_id / transient
+    decision schedule IDs).
+  * **validation**: malformed histories (orphan completions, double fires,
+    slot overflow) are rejected here with the same strictness as the host
+    oracle, so the kernel can assume well-formed input.
+
+Histories whose pending sets exceed `Capacities` raise
+``PackOverflowError`` — callers route those to the host replay path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.ids import EMPTY_EVENT_ID
+from cadence_tpu.utils.hashing import hash31
+
+from . import schema as S
+
+SECONDS = 1_000_000_000  # ns per second
+_INT32_MAX = 2**31 - 1
+
+
+class PackError(Exception):
+    """History cannot be packed (malformed event stream)."""
+
+
+class PackOverflowError(PackError):
+    """History exceeds slot-table capacities — route to host replay."""
+
+
+@dataclasses.dataclass
+class WorkflowSideTable:
+    """Host-side strings for one workflow, keyed by slot — merged back into
+    snapshots by ops/unpack.py. Strings never influence transitions."""
+
+    workflow_id: str = ""
+    run_id: str = ""
+    request_id: str = ""
+    task_list: str = ""
+    workflow_type: str = ""
+    cron_schedule: str = ""
+    parent_domain: str = ""
+    parent_workflow_id: str = ""
+    parent_run_id: str = ""
+    memo: Dict[str, bytes] = dataclasses.field(default_factory=dict)
+    search_attributes: Dict[str, bytes] = dataclasses.field(default_factory=dict)
+    continued_execution_run_id: str = ""
+    # slot → strings
+    activity_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
+    activity_task_lists: Dict[int, str] = dataclasses.field(default_factory=dict)
+    timer_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
+    child_domains: Dict[int, str] = dataclasses.field(default_factory=dict)
+    child_workflow_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
+    child_run_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
+    child_types: Dict[int, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PackedHistories:
+    """Batched event tensors + host side tables."""
+
+    events: np.ndarray        # [B, T, EV_N] int32
+    lengths: np.ndarray       # [B] int32 — valid event count per row
+    side: List[WorkflowSideTable]
+    caps: S.Capacities
+
+    @property
+    def batch(self) -> int:
+        return self.events.shape[0]
+
+    def time_major(self) -> np.ndarray:
+        """[T, B, EV_N] — the layout lax.scan consumes."""
+        return np.ascontiguousarray(np.transpose(self.events, (1, 0, 2)))
+
+
+class _SlotTable:
+    """Deterministic lowest-free-slot allocator keyed by an id."""
+
+    def __init__(self, capacity: int, kind: str) -> None:
+        self.capacity = capacity
+        self.kind = kind
+        self.by_key: Dict[Any, int] = {}
+        self.free: List[int] = list(range(capacity))  # kept sorted
+
+    def alloc(self, key: Any) -> int:
+        if not self.free:
+            raise PackOverflowError(
+                f"pending {self.kind} capacity {self.capacity} exceeded"
+            )
+        slot = self.free.pop(0)
+        self.by_key[key] = slot
+        return slot
+
+    def get(self, key: Any) -> Optional[int]:
+        return self.by_key.get(key)
+
+    def release(self, key: Any) -> int:
+        if key not in self.by_key:
+            raise PackError(f"unknown {self.kind} key {key!r}")
+        slot = self.by_key.pop(key)
+        # insert keeping order (capacities are small)
+        i = 0
+        while i < len(self.free) and self.free[i] < slot:
+            i += 1
+        self.free.insert(i, slot)
+        return slot
+
+
+def _ts_seconds(ns: int) -> int:
+    s = ns // SECONDS
+    if not (0 <= s <= _INT32_MAX):
+        raise PackError(f"timestamp {ns} does not fit int32 seconds")
+    return int(s)
+
+
+def pack_workflow(
+    batches: Sequence[Sequence[HistoryEvent]],
+    caps: S.Capacities,
+    workflow_id: str = "",
+    run_id: str = "",
+    request_id: str = "",
+) -> Tuple[np.ndarray, WorkflowSideTable]:
+    """Pack one workflow's history (a sequence of transaction batches) into
+    an [n_events, EV_N] int32 array + its side table."""
+
+    side = WorkflowSideTable(
+        workflow_id=workflow_id, run_id=run_id, request_id=request_id
+    )
+    acts = _SlotTable(caps.max_activities, "activity")
+    acts_by_name: Dict[str, int] = {}  # activity_id → live slot
+    timers = _SlotTable(caps.max_timers, "timer")
+    children = _SlotTable(caps.max_children, "child")
+    cancels = _SlotTable(caps.max_request_cancels, "request-cancel")
+    signals = _SlotTable(caps.max_signals_ext, "external-signal")
+
+    rows: List[List[int]] = []
+    n_events = sum(len(b) for b in batches)
+    if n_events > caps.max_events:
+        raise PackOverflowError(
+            f"history length {n_events} exceeds max_events {caps.max_events}"
+        )
+
+    version_changes = 0
+    last_version: Optional[int] = None
+
+    for batch in batches:
+        if not batch:
+            raise PackError("empty event batch")
+        batch_first = batch[0].event_id
+        for i, ev in enumerate(batch):
+            et = ev.event_type
+            a = ev.attributes
+            slot = -1
+            attrs = [0] * 8
+
+            if last_version is None or ev.version != last_version:
+                if last_version is not None and ev.version < last_version:
+                    # same strictness as VersionHistory.add_or_update_item
+                    raise PackError(
+                        f"event version {ev.version} < last version {last_version}"
+                    )
+                version_changes += 1
+                last_version = ev.version
+            if version_changes > caps.max_version_items:
+                raise PackOverflowError(
+                    f"version-history items exceed {caps.max_version_items}"
+                )
+
+            if et == EventType.WorkflowExecutionStarted:
+                side.task_list = a.get("task_list", "")
+                side.workflow_type = a.get("workflow_type", "")
+                side.cron_schedule = a.get("cron_schedule", "")
+                side.parent_domain = a.get("parent_workflow_domain") or ""
+                side.parent_workflow_id = a.get("parent_workflow_id") or ""
+                side.parent_run_id = a.get("parent_run_id") or ""
+                side.continued_execution_run_id = a.get("continued_execution_run_id", "")
+                side.memo = dict(a.get("memo") or {})
+                side.search_attributes = dict(a.get("search_attributes") or {})
+                rp = a.get("retry_policy")
+                attrs[0] = a.get("execution_start_to_close_timeout_seconds", 0)
+                attrs[1] = a.get("task_start_to_close_timeout_seconds", 0)
+                attrs[2] = a.get("attempt", 0)
+                attrs[3] = 1 if rp is not None else 0
+                exp = a.get("expiration_timestamp", 0)
+                attrs[4] = _ts_seconds(exp) if exp else 0
+                attrs[5] = a.get("first_decision_task_backoff_seconds", 0)
+                attrs[6] = a.get("initiator", 0)
+                attrs[7] = a.get("parent_initiated_event_id", EMPTY_EVENT_ID)
+
+            elif et == EventType.DecisionTaskScheduled:
+                attrs[0] = a.get("start_to_close_timeout_seconds", 0)
+                attrs[1] = a.get("attempt", 0)
+
+            elif et == EventType.DecisionTaskStarted:
+                attrs[0] = a.get("scheduled_event_id", EMPTY_EVENT_ID)
+
+            elif et == EventType.DecisionTaskCompleted:
+                attrs[0] = a.get("started_event_id", EMPTY_EVENT_ID)
+
+            elif et == EventType.DecisionTaskTimedOut:
+                attrs[0] = a.get("timeout_type", 0)
+
+            elif et == EventType.DecisionTaskFailed:
+                pass
+
+            elif et == EventType.ActivityTaskScheduled:
+                activity_id = a.get("activity_id", "")
+                slot = acts.alloc(ev.event_id)
+                acts_by_name[activity_id] = slot
+                side.activity_ids[slot] = activity_id
+                side.activity_task_lists[slot] = a.get("task_list", "")
+                rp = a.get("retry_policy")
+                attrs[0] = hash31(activity_id)
+                attrs[1] = a.get("schedule_to_start_timeout_seconds", 0)
+                attrs[2] = a.get("schedule_to_close_timeout_seconds", 0)
+                attrs[3] = a.get("start_to_close_timeout_seconds", 0)
+                attrs[4] = a.get("heartbeat_timeout_seconds", 0)
+                attrs[5] = 1 if rp is not None else 0
+                attrs[6] = (rp or {}).get("expiration_interval_seconds", 0)
+
+            elif et == EventType.ActivityTaskStarted:
+                sched = a.get("scheduled_event_id", EMPTY_EVENT_ID)
+                slot = acts.get(sched)
+                if slot is None:
+                    raise PackError(f"activity started for unknown schedule {sched}")
+                attrs[0] = sched
+                attrs[1] = a.get("attempt", 0)
+
+            elif et in (
+                EventType.ActivityTaskCompleted,
+                EventType.ActivityTaskFailed,
+                EventType.ActivityTaskTimedOut,
+                EventType.ActivityTaskCanceled,
+            ):
+                sched = a.get("scheduled_event_id", EMPTY_EVENT_ID)
+                slot = acts.release(sched)
+                name = side.activity_ids.get(slot, "")
+                if acts_by_name.get(name) == slot:
+                    acts_by_name.pop(name, None)
+                attrs[0] = sched
+                if et == EventType.ActivityTaskTimedOut:
+                    attrs[1] = a.get("timeout_type", 0)
+
+            elif et == EventType.ActivityTaskCancelRequested:
+                activity_id = a.get("activity_id", "")
+                slot = acts_by_name.get(activity_id)
+                if slot is None:
+                    raise PackError(
+                        f"cancel requested for unknown activity {activity_id!r}"
+                    )
+                attrs[0] = hash31(activity_id)
+
+            elif et == EventType.RequestCancelActivityTaskFailed:
+                pass
+
+            elif et == EventType.TimerStarted:
+                timer_id = a.get("timer_id", "")
+                if timers.get(timer_id) is not None:
+                    raise PackError(f"duplicate timer id {timer_id!r}")
+                slot = timers.alloc(timer_id)
+                side.timer_ids[slot] = timer_id
+                attrs[0] = hash31(timer_id)
+                attrs[1] = a.get("start_to_fire_timeout_seconds", 0)
+
+            elif et in (EventType.TimerFired, EventType.TimerCanceled):
+                timer_id = a.get("timer_id", "")
+                slot = timers.release(timer_id)
+                attrs[0] = a.get("started_event_id", EMPTY_EVENT_ID)
+                attrs[1] = hash31(timer_id)
+
+            elif et == EventType.CancelTimerFailed:
+                pass
+
+            elif et == EventType.StartChildWorkflowExecutionInitiated:
+                slot = children.alloc(ev.event_id)
+                side.child_domains[slot] = a.get("domain", "")
+                side.child_workflow_ids[slot] = a.get("workflow_id", "")
+                side.child_types[slot] = a.get("workflow_type", "")
+                attrs[0] = hash31(a.get("workflow_id", ""))
+                attrs[1] = a.get("parent_close_policy", 0)
+
+            elif et == EventType.ChildWorkflowExecutionStarted:
+                init = a.get("initiated_event_id", EMPTY_EVENT_ID)
+                slot = children.get(init)
+                if slot is None:
+                    raise PackError(f"child started for unknown initiated {init}")
+                run_id = a.get("run_id", "")
+                side.child_run_ids[slot] = run_id
+                attrs[0] = init
+                attrs[1] = hash31(run_id) if run_id else 0
+
+            elif et in (
+                EventType.StartChildWorkflowExecutionFailed,
+                EventType.ChildWorkflowExecutionCompleted,
+                EventType.ChildWorkflowExecutionFailed,
+                EventType.ChildWorkflowExecutionCanceled,
+                EventType.ChildWorkflowExecutionTimedOut,
+                EventType.ChildWorkflowExecutionTerminated,
+            ):
+                init = a.get("initiated_event_id", EMPTY_EVENT_ID)
+                slot = children.release(init)
+                attrs[0] = init
+
+            elif et == EventType.RequestCancelExternalWorkflowExecutionInitiated:
+                slot = cancels.alloc(ev.event_id)
+
+            elif et in (
+                EventType.RequestCancelExternalWorkflowExecutionFailed,
+                EventType.ExternalWorkflowExecutionCancelRequested,
+            ):
+                init = a.get("initiated_event_id", EMPTY_EVENT_ID)
+                slot = cancels.release(init)
+                attrs[0] = init
+
+            elif et == EventType.SignalExternalWorkflowExecutionInitiated:
+                slot = signals.alloc(ev.event_id)
+
+            elif et in (
+                EventType.SignalExternalWorkflowExecutionFailed,
+                EventType.ExternalWorkflowExecutionSignaled,
+            ):
+                init = a.get("initiated_event_id", EMPTY_EVENT_ID)
+                slot = signals.release(init)
+                attrs[0] = init
+
+            elif et == EventType.UpsertWorkflowSearchAttributes:
+                side.search_attributes.update(a.get("search_attributes", {}))
+
+            elif et in (
+                EventType.MarkerRecorded,
+                EventType.WorkflowExecutionSignaled,
+                EventType.WorkflowExecutionCancelRequested,
+                EventType.WorkflowExecutionCompleted,
+                EventType.WorkflowExecutionFailed,
+                EventType.WorkflowExecutionTimedOut,
+                EventType.WorkflowExecutionCanceled,
+                EventType.WorkflowExecutionTerminated,
+                EventType.WorkflowExecutionContinuedAsNew,
+            ):
+                pass
+
+            else:
+                raise PackError(f"unknown event type {et}")
+
+            rows.append([
+                int(et),
+                ev.event_id,
+                ev.version,
+                ev.task_id,
+                _ts_seconds(ev.timestamp),
+                batch_first,
+                1 if i == len(batch) - 1 else 0,
+                slot,
+                *attrs,
+            ])
+
+    arr = np.asarray(rows, dtype=np.int64)
+    if arr.size and (arr.max() > _INT32_MAX or arr.min() < -(2**31)):
+        raise PackError("event field does not fit int32")
+    return arr.astype(np.int32), side
+
+
+def pack_histories(
+    histories: Sequence[Tuple[str, str, Sequence[Sequence[HistoryEvent]]]],
+    caps: Optional[S.Capacities] = None,
+    pad_batch_to: Optional[int] = None,
+) -> PackedHistories:
+    """Pack many workflows into one padded [B, T, EV_N] tensor.
+
+    ``histories``: sequence of (workflow_id, run_id, batches).
+    ``pad_batch_to``: round the batch dim up (e.g. to a multiple of the
+    device-mesh size for even sharding).
+    """
+    caps = caps or S.Capacities()
+    b = len(histories)
+    bp = max(pad_batch_to or b, b)
+    events = np.full((bp, caps.max_events, S.EV_N), 0, dtype=np.int32)
+    events[:, :, S.EV_TYPE] = -1  # padding sentinel
+    lengths = np.zeros((bp,), dtype=np.int32)
+    side: List[WorkflowSideTable] = []
+    for idx, (wf_id, run_id, batches) in enumerate(histories):
+        arr, st = pack_workflow(batches, caps, workflow_id=wf_id, run_id=run_id)
+        n = arr.shape[0]
+        events[idx, :n, :] = arr
+        lengths[idx] = n
+        side.append(st)
+    for _ in range(bp - b):
+        side.append(WorkflowSideTable())
+    return PackedHistories(events=events, lengths=lengths, side=side, caps=caps)
+
+

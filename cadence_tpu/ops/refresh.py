@@ -1,0 +1,304 @@
+"""Vectorized task refresh: outstanding queue tasks from final state.
+
+Device twin of cadence_tpu/core/task_refresher.py (itself the twin of the
+reference's mutableStateTaskRefresher). Runs as a jitted post-pass after
+the replay scan, so the rebuild pipeline — scan → refresh — stays on
+device; outputs are compact int32 arrays the host hydrates into
+TransferTask/TimerTask records (sentinel -1 = absent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cadence_tpu.core.enums import (
+    TimeoutType,
+    TimerTaskType,
+    TransferTaskType,
+    WorkflowState,
+)
+from cadence_tpu.core.ids import EMPTY_EVENT_ID
+from cadence_tpu.core import tasks as T
+from cadence_tpu.core.mutable_state import SECOND
+
+from . import schema as S
+from .pack import PackedHistories
+
+_BIG = jnp.int32(2**31 - 1)
+
+
+@dataclasses.dataclass
+class RefreshedTasks:
+    """Compact task arrays; -1 marks absent entries."""
+
+    close_transfer: Any          # [B] bool
+    workflow_timeout_ts: Any     # [B] int32 (-1 if closed)
+    decision_transfer: Any       # [B] schedule_id or -1
+    decision_timer: Any          # [B, 3] (vis_ts, schedule_id, attempt) or -1s
+    activity_transfer: Any       # [B, A] schedule_id or -1
+    activity_timer: Any          # [B, 5] (vis_ts, timeout_type, schedule_id, attempt, version) or -1s
+    user_timer: Any              # [B, 3] (vis_ts, started_id, version) or -1s
+    child_transfer: Any          # [B, C] initiated_id or -1
+    cancel_transfer: Any         # [B, RC] initiated_id or -1
+    signal_transfer: Any         # [B, SG] initiated_id or -1
+
+    def tree_flatten(self):
+        return (
+            (
+                self.close_transfer, self.workflow_timeout_ts,
+                self.decision_transfer, self.decision_timer,
+                self.activity_transfer, self.activity_timer, self.user_timer,
+                self.child_transfer, self.cancel_transfer, self.signal_transfer,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    RefreshedTasks, lambda s: s.tree_flatten(), RefreshedTasks.tree_unflatten
+)
+
+
+def refresh_tasks_device(state: S.StateTensors) -> RefreshedTasks:
+    ex = state.exec_info
+    running = (ex[:, S.X_STATE] == int(WorkflowState.Created)) | (
+        ex[:, S.X_STATE] == int(WorkflowState.Running)
+    )
+    neg1 = jnp.int32(-1)
+
+    close_transfer = ~running
+    workflow_timeout_ts = jnp.where(
+        running, ex[:, S.X_START_TS] + ex[:, S.X_WORKFLOW_TIMEOUT], neg1
+    )
+
+    has_pending_dec = running & (ex[:, S.X_DEC_SCHEDULE_ID] != EMPTY_EVENT_ID)
+    decision_transfer = jnp.where(has_pending_dec, ex[:, S.X_DEC_SCHEDULE_ID], neg1)
+    inflight = has_pending_dec & (ex[:, S.X_DEC_STARTED_ID] > 0)
+    decision_timer = jnp.stack([
+        jnp.where(inflight, ex[:, S.X_DEC_STARTED_TS] + ex[:, S.X_DEC_TIMEOUT], neg1),
+        jnp.where(inflight, ex[:, S.X_DEC_SCHEDULE_ID], neg1),
+        jnp.where(inflight, ex[:, S.X_DEC_ATTEMPT], neg1),
+    ], axis=-1)
+
+    # activity transfer: occupied & unstarted
+    acts = state.activities
+    a_occ = acts[:, :, S.AC_OCC] > 0
+    a_unstarted = a_occ & (acts[:, :, S.AC_STARTED_ID] == EMPTY_EVENT_ID)
+    activity_transfer = jnp.where(
+        a_unstarted & running[:, None], acts[:, :, S.AC_SCHEDULE_ID], neg1
+    )
+
+    # activity timeout argmin over (slot, kind) candidates — mirrors
+    # TimerSequence._activity_timeout_candidates ordering (expiry,
+    # schedule_id, timeout_type)
+    started = a_occ & (acts[:, :, S.AC_STARTED_ID] != EMPTY_EVENT_ID)
+    sched_ts = acts[:, :, S.AC_SCHEDULED_TS]
+    cands = []  # (armed, expiry, timeout_type)
+    cands.append((
+        a_unstarted & (acts[:, :, S.AC_SCH_TO_START] > 0),
+        sched_ts + acts[:, :, S.AC_SCH_TO_START],
+        int(TimeoutType.ScheduleToStart),
+    ))
+    cands.append((
+        a_unstarted & (acts[:, :, S.AC_SCH_TO_CLOSE] > 0),
+        sched_ts + acts[:, :, S.AC_SCH_TO_CLOSE],
+        int(TimeoutType.ScheduleToClose),
+    ))
+    cands.append((
+        started & (acts[:, :, S.AC_SCH_TO_CLOSE] > 0),
+        sched_ts + acts[:, :, S.AC_SCH_TO_CLOSE],
+        int(TimeoutType.ScheduleToClose),
+    ))
+    cands.append((
+        started & (acts[:, :, S.AC_START_TO_CLOSE] > 0),
+        acts[:, :, S.AC_STARTED_TS] + acts[:, :, S.AC_START_TO_CLOSE],
+        int(TimeoutType.StartToClose),
+    ))
+    cands.append((
+        started & (acts[:, :, S.AC_HEARTBEAT] > 0),
+        acts[:, :, S.AC_LAST_HB_TS] + acts[:, :, S.AC_HEARTBEAT],
+        int(TimeoutType.Heartbeat),
+    ))
+    # lexicographic argmin on (expiry, schedule_id, timeout_type): exact
+    # two-stage reductions (min expiry, then min schedule_id among ties)
+    best = None
+    for armed, expiry, tt in cands:
+        armed = armed & running[:, None]
+        expiry_m = jnp.where(armed, expiry, _BIG)
+        sid_m = jnp.where(armed, acts[:, :, S.AC_SCHEDULE_ID], _BIG)
+        k_exp = jnp.min(expiry_m, axis=1)
+        sid_tie = jnp.where(expiry_m == k_exp[:, None], sid_m, _BIG)
+        k_sid = jnp.min(sid_tie, axis=1)
+        winner = sid_tie == k_sid[:, None]  # [B, A] unique occupied slot
+        k_attempt = jnp.max(
+            jnp.where(winner, acts[:, :, S.AC_ATTEMPT], 0), axis=1
+        )
+        k_version = jnp.max(
+            jnp.where(winner, acts[:, :, S.AC_VERSION], jnp.int32(-(2**31))), axis=1
+        )
+        key = (k_exp, k_sid, jnp.full_like(k_exp, tt), k_attempt, k_version)
+        if best is None:
+            best = key
+        else:
+            better = (key[0] < best[0]) | (
+                (key[0] == best[0]) & (key[1] < best[1])
+            ) | (
+                (key[0] == best[0]) & (key[1] == best[1]) & (key[2] < best[2])
+            )
+            best = tuple(jnp.where(better, k, b) for k, b in zip(key, best))
+    a_exp, a_sid, a_tt, a_att, a_ver = best
+    has_at = a_exp < _BIG
+    activity_timer = jnp.stack([
+        jnp.where(has_at, a_exp, neg1),
+        jnp.where(has_at, a_tt, neg1),
+        jnp.where(has_at, a_sid, neg1),
+        jnp.where(has_at, a_att, neg1),
+        jnp.where(has_at, a_ver, neg1),
+    ], axis=-1)
+
+    # earliest user timer (expiry, started_id)
+    tmr = state.timers
+    t_occ = (tmr[:, :, S.TI_OCC] > 0) & running[:, None]
+    t_exp = jnp.where(t_occ, tmr[:, :, S.TI_EXPIRY_TS], _BIG)
+    t_sid = jnp.where(t_occ, tmr[:, :, S.TI_STARTED_ID], _BIG)
+    u_exp = jnp.min(t_exp, axis=1)
+    sid_tie = jnp.where(t_exp == u_exp[:, None], t_sid, _BIG)
+    u_sid = jnp.min(sid_tie, axis=1)
+    u_ver = jnp.max(
+        jnp.where(sid_tie == u_sid[:, None], tmr[:, :, S.TI_VERSION],
+                  jnp.int32(-(2**31))),
+        axis=1,
+    )
+    has_ut = u_exp < _BIG
+    user_timer = jnp.stack([
+        jnp.where(has_ut, u_exp, neg1),
+        jnp.where(has_ut, u_sid, neg1),
+        jnp.where(has_ut, u_ver, neg1),
+    ], axis=-1)
+
+    ch = state.children
+    ch_pending = (ch[:, :, S.CH_OCC] > 0) & (
+        ch[:, :, S.CH_STARTED_ID] == EMPTY_EVENT_ID
+    ) & running[:, None]
+    child_transfer = jnp.where(ch_pending, ch[:, :, S.CH_INITIATED_ID], neg1)
+
+    rc = state.cancels
+    cancel_transfer = jnp.where(
+        (rc[:, :, S.RC_OCC] > 0) & running[:, None],
+        rc[:, :, S.RC_INITIATED_ID], neg1,
+    )
+    sg = state.signals
+    signal_transfer = jnp.where(
+        (sg[:, :, S.SG_OCC] > 0) & running[:, None],
+        sg[:, :, S.SG_INITIATED_ID], neg1,
+    )
+
+    return RefreshedTasks(
+        close_transfer=close_transfer,
+        workflow_timeout_ts=workflow_timeout_ts,
+        decision_transfer=decision_transfer,
+        decision_timer=decision_timer,
+        activity_transfer=activity_transfer,
+        activity_timer=activity_timer,
+        user_timer=user_timer,
+        child_transfer=child_transfer,
+        cancel_transfer=cancel_transfer,
+        signal_transfer=signal_transfer,
+    )
+
+
+refresh_tasks_device_jit = jax.jit(refresh_tasks_device)
+
+
+def refreshed_to_numpy(refreshed: RefreshedTasks) -> RefreshedTasks:
+    """One device→host transfer for the whole batch; do this once before
+    hydrating workflows in a loop."""
+    return jax.tree_util.tree_map(np.asarray, refreshed)
+
+
+def hydrate_tasks(
+    refreshed: RefreshedTasks, b: int, packed: PackedHistories, domain_id: str = ""
+) -> Tuple[List[T.TransferTask], List[T.TimerTask]]:
+    """Expand workflow ``b``'s compact arrays into task records, in the same
+    deterministic order as core.task_refresher.refresh_tasks."""
+    r = refreshed
+    if not isinstance(r.close_transfer, np.ndarray):
+        r = refreshed_to_numpy(r)
+    side = packed.side[b]
+    transfer: List[T.TransferTask] = []
+    timer: List[T.TimerTask] = []
+
+    if r.close_transfer[b]:
+        transfer.append(T.close_execution_transfer_task())
+        return transfer, timer
+
+    timer.append(T.TimerTask(
+        task_type=TimerTaskType.WorkflowTimeout,
+        visibility_timestamp=int(r.workflow_timeout_ts[b]) * SECOND,
+    ))
+    if r.decision_transfer[b] != -1:
+        transfer.append(T.decision_transfer_task(
+            domain_id, side.task_list, int(r.decision_transfer[b])
+        ))
+        if r.decision_timer[b][0] != -1:
+            vis, sid, attempt = (int(x) for x in r.decision_timer[b])
+            timer.append(T.TimerTask(
+                task_type=TimerTaskType.DecisionTimeout,
+                visibility_timestamp=vis * SECOND,
+                timeout_type=int(TimeoutType.StartToClose),
+                event_id=sid,
+                schedule_attempt=attempt,
+            ))
+    sids = sorted(int(x) for x in r.activity_transfer[b] if x != -1)
+    slot_by_sid = {}
+    for slot, x in enumerate(r.activity_transfer[b]):
+        if x != -1:
+            slot_by_sid[int(x)] = slot
+    for sid in sids:
+        transfer.append(T.activity_transfer_task(
+            domain_id, side.activity_task_lists.get(slot_by_sid[sid], ""), sid
+        ))
+    if r.activity_timer[b][0] != -1:
+        vis, tt, sid, attempt, ver = (int(x) for x in r.activity_timer[b])
+        timer.append(T.TimerTask(
+            task_type=TimerTaskType.ActivityTimeout,
+            visibility_timestamp=vis * SECOND,
+            timeout_type=tt,
+            event_id=sid,
+            schedule_attempt=attempt,
+            version=ver,
+        ))
+    if r.user_timer[b][0] != -1:
+        vis, sid, ver = (int(x) for x in r.user_timer[b])
+        timer.append(T.TimerTask(
+            task_type=TimerTaskType.UserTimer,
+            visibility_timestamp=vis * SECOND,
+            event_id=sid,
+            version=ver,
+        ))
+    for init in sorted(int(x) for x in r.child_transfer[b] if x != -1):
+        slot = next(
+            s for s, x in enumerate(r.child_transfer[b]) if int(x) == init
+        )
+        transfer.append(T.start_child_transfer_task(
+            side.child_domains.get(slot, ""),
+            side.child_workflow_ids.get(slot, ""), init,
+        ))
+    for init in sorted(int(x) for x in r.cancel_transfer[b] if x != -1):
+        transfer.append(T.TransferTask(
+            task_type=TransferTaskType.CancelExecution, initiated_id=init
+        ))
+    for init in sorted(int(x) for x in r.signal_transfer[b] if x != -1):
+        transfer.append(T.TransferTask(
+            task_type=TransferTaskType.SignalExecution, initiated_id=init
+        ))
+    return transfer, timer

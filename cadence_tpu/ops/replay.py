@@ -1,0 +1,364 @@
+"""The batched replay kernel — the north star.
+
+Replays thousands of workflow histories as one vectorized finite-state-
+machine simulation: ``lax.scan`` over the (padded) time axis, every step
+applying one event row per workflow to the dense state tensors with masked
+updates. Branchless by construction: the event-type × transition function
+is expressed as per-type masks blended with ``jnp.where`` (all transitions
+are computed for all lanes and selected — the VPU-friendly formulation),
+and pending-map scatter writes use one-hot slot masks precomputed by the
+packer.
+
+Semantics are the oracle's (cadence_tpu/core/state_builder.py ==
+/root/reference/service/history/stateBuilder.go:112-613 +
+mutableStateBuilder Replicate* methods); differential tests assert parity.
+Two deliberate deviations, both matching the reference's *rebuild* path
+(nDCStateRebuilder.go:92-160):
+
+  * timer-task dedup bits (AC_TIMER_STATUS / TI_STATUS) are not tracked
+    in-scan; the reference's taskRefresher resets and regenerates them
+    after a rebuild, which ops/refresh.py does vectorized.
+  * per-event transfer/timer tasks are not emitted from the scan (O(B*T)
+    memory); they're regenerated from final state by ops/refresh.py.
+
+TPU notes: all state is int32 (VPU-native); the scan is memory-bound on
+HBM (state read+write per step), so capacities directly set the bytes/step
+— keep slot tables as small as the workload allows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+from cadence_tpu.core.enums import CloseStatus, EventType as E, TimeoutType, WorkflowState
+from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION
+
+from . import schema as S
+from .pack import PackedHistories
+
+
+def _set(ex, col, mask, val):
+    """exec column masked update."""
+    return ex.at[:, col].set(jnp.where(mask, val, ex[:, col]))
+
+
+def _slot_mask(ev, mask, capacity):
+    """[B, capacity] one-hot of EV_SLOT under ``mask``."""
+    slot = ev[:, S.EV_SLOT]
+    return mask[:, None] & (slot[:, None] == jnp.arange(capacity)[None, :])
+
+
+def _blend_rows(table, onehot, row):
+    """table[B, N, C] ← row[B, C] where onehot[B, N]."""
+    return jnp.where(onehot[:, :, None], row[:, None, :], table)
+
+
+def _clear_rows(table, onehot):
+    return jnp.where(onehot[:, :, None], 0, table)
+
+
+def _set_cell(table, onehot, col, val):
+    """table[:, :, col] ← val[B] (broadcast over slots) where onehot."""
+    return table.at[:, :, col].set(
+        jnp.where(onehot, val[:, None], table[:, :, col])
+    )
+
+
+def replay_step(state: S.StateTensors, ev: jnp.ndarray) -> S.StateTensors:
+    """Apply one event row per workflow. ev: [B, EV_N] int32."""
+    et = ev[:, S.EV_TYPE]
+    valid = et >= 0
+
+    def m(*types):
+        out = jnp.zeros_like(valid)
+        for t in types:
+            out = out | (et == int(t))
+        return valid & out
+
+    ev_id = ev[:, S.EV_ID]
+    version = ev[:, S.EV_VERSION]
+    task_id = ev[:, S.EV_TASK_ID]
+    ts = ev[:, S.EV_TS]
+    batch_first = ev[:, S.EV_BATCH_FIRST]
+    a0, a1, a2, a3 = (ev[:, S.EV_A0], ev[:, S.EV_A1], ev[:, S.EV_A2], ev[:, S.EV_A3])
+    a4, a5, a6, a7 = (ev[:, S.EV_A4], ev[:, S.EV_A5], ev[:, S.EV_A6], ev[:, S.EV_A7])
+
+    ex = state.exec_info
+
+    # ---- common preamble (stateBuilder.go:134-155 + batch-end bookkeeping)
+    ex = _set(ex, S.X_LAST_EVENT_TASK_ID, valid, task_id)
+    ex = _set(ex, S.X_CUR_VERSION, valid, version)
+    ex = _set(ex, S.X_NEXT_EVENT_ID, valid, ev_id + 1)
+    ex = _set(ex, S.X_LAST_FIRST_EVENT_ID, valid, batch_first)
+
+    # ---- version-history add_or_update (versionHistory.go AddOrUpdateItem)
+    vh_items, vh_len = state.vh_items, state.vh_len
+    cap_v = vh_items.shape[1]
+    last_idx = jnp.maximum(vh_len - 1, 0)
+    last_ver = jnp.take_along_axis(
+        vh_items[:, :, 1], last_idx[:, None], axis=1
+    )[:, 0]
+    same = (vh_len > 0) & (last_ver == version)
+    write_idx = jnp.where(same, last_idx, jnp.minimum(vh_len, cap_v - 1))
+    wmask = valid[:, None] & (write_idx[:, None] == jnp.arange(cap_v)[None, :])
+    vh_items = vh_items.at[:, :, 0].set(jnp.where(wmask, ev_id[:, None], vh_items[:, :, 0]))
+    vh_items = vh_items.at[:, :, 1].set(jnp.where(wmask, version[:, None], vh_items[:, :, 1]))
+    vh_len = jnp.where(valid & ~same, vh_len + 1, vh_len)
+
+    # ---- workflow lifecycle ------------------------------------------------
+    m_start = m(E.WorkflowExecutionStarted)
+    ex = _set(ex, S.X_STATE, m_start, int(WorkflowState.Created))
+    ex = _set(ex, S.X_CLOSE_STATUS, m_start, int(CloseStatus.NONE))
+    ex = _set(ex, S.X_LAST_PROCESSED_EVENT, m_start, EMPTY_EVENT_ID)
+    ex = _set(ex, S.X_START_TS, m_start, ts)
+    ex = _set(ex, S.X_WORKFLOW_TIMEOUT, m_start, a0)
+    ex = _set(ex, S.X_DECISION_TIMEOUT_VALUE, m_start, a1)
+    ex = _set(ex, S.X_ATTEMPT, m_start, a2)
+    ex = _set(ex, S.X_HAS_RETRY_POLICY, m_start, a3)
+    ex = _set(ex, S.X_WF_EXPIRATION_TS, m_start, a4)
+    ex = _set(ex, S.X_PARENT_INITIATED_ID, m_start, a7)
+    for col in (S.X_DEC_SCHEDULE_ID, S.X_DEC_STARTED_ID):
+        ex = _set(ex, col, m_start, EMPTY_EVENT_ID)
+    ex = _set(ex, S.X_DEC_VERSION, m_start, EMPTY_VERSION)
+    for col in (S.X_DEC_TIMEOUT, S.X_DEC_ATTEMPT, S.X_DEC_SCHEDULED_TS,
+                S.X_DEC_STARTED_TS, S.X_DEC_ORIGINAL_SCHEDULED_TS):
+        ex = _set(ex, col, m_start, 0)
+
+    close_status = (
+        m(E.WorkflowExecutionCompleted) * int(CloseStatus.Completed)
+        + m(E.WorkflowExecutionFailed) * int(CloseStatus.Failed)
+        + m(E.WorkflowExecutionTimedOut) * int(CloseStatus.TimedOut)
+        + m(E.WorkflowExecutionCanceled) * int(CloseStatus.Canceled)
+        + m(E.WorkflowExecutionTerminated) * int(CloseStatus.Terminated)
+        + m(E.WorkflowExecutionContinuedAsNew) * int(CloseStatus.ContinuedAsNew)
+    )
+    m_close = close_status > 0
+    ex = _set(ex, S.X_STATE, m_close, int(WorkflowState.Completed))
+    ex = _set(ex, S.X_CLOSE_STATUS, m_close, close_status)
+    ex = _set(ex, S.X_COMPLETION_EVENT_BATCH_ID, m_close, batch_first)
+
+    ex = _set(ex, S.X_CANCEL_REQUESTED, m(E.WorkflowExecutionCancelRequested), 1)
+    m_sig = m(E.WorkflowExecutionSignaled)
+    ex = _set(ex, S.X_SIGNAL_COUNT, m_sig, ex[:, S.X_SIGNAL_COUNT] + 1)
+
+    # ---- decision sub-FSM (mutableStateDecisionTaskManager.go) -------------
+    m_dsch = m(E.DecisionTaskScheduled)
+    ex = _set(ex, S.X_DEC_VERSION, m_dsch, version)
+    ex = _set(ex, S.X_DEC_SCHEDULE_ID, m_dsch, ev_id)
+    ex = _set(ex, S.X_DEC_STARTED_ID, m_dsch, EMPTY_EVENT_ID)
+    ex = _set(ex, S.X_DEC_TIMEOUT, m_dsch, a0)
+    ex = _set(ex, S.X_DEC_ATTEMPT, m_dsch, a1)
+    ex = _set(ex, S.X_DEC_SCHEDULED_TS, m_dsch, ts)
+    ex = _set(ex, S.X_DEC_ORIGINAL_SCHEDULED_TS, m_dsch, ts)
+    ex = _set(ex, S.X_DEC_STARTED_TS, m_dsch, 0)
+
+    m_dsta = m(E.DecisionTaskStarted)
+    # Created → Running on first decision start (:228-235)
+    ex = _set(
+        ex, S.X_STATE,
+        m_dsta & (ex[:, S.X_STATE] == int(WorkflowState.Created)),
+        int(WorkflowState.Running),
+    )
+    ex = _set(ex, S.X_DEC_VERSION, m_dsta, version)
+    ex = _set(ex, S.X_DEC_STARTED_ID, m_dsta, ev_id)
+    ex = _set(ex, S.X_DEC_ATTEMPT, m_dsta, 0)  # replication magic (:216-224)
+    ex = _set(ex, S.X_DEC_STARTED_TS, m_dsta, ts)
+
+    m_dcom = m(E.DecisionTaskCompleted)
+    # delete decision, keep original-scheduled ts (:659-674)
+    ex = _set(ex, S.X_DEC_VERSION, m_dcom, EMPTY_VERSION)
+    ex = _set(ex, S.X_DEC_SCHEDULE_ID, m_dcom, EMPTY_EVENT_ID)
+    ex = _set(ex, S.X_DEC_STARTED_ID, m_dcom, EMPTY_EVENT_ID)
+    for col in (S.X_DEC_TIMEOUT, S.X_DEC_ATTEMPT, S.X_DEC_SCHEDULED_TS,
+                S.X_DEC_STARTED_TS):
+        ex = _set(ex, col, m_dcom, 0)
+    ex = _set(ex, S.X_LAST_PROCESSED_EVENT, m_dcom, a0)
+
+    # fail/timeout → fail_decision(+transient schedule) fused:
+    m_dto = m(E.DecisionTaskTimedOut)
+    m_dfail = m(E.DecisionTaskFailed)
+    increment = m_dfail | (m_dto & (a0 != int(TimeoutType.ScheduleToStart)))
+    no_increment = (m_dto | m_dfail) & ~increment
+    # transient decision fires iff attempt was incremented (oracle:
+    # replicate_transient_decision_task_scheduled precondition collapses to
+    # `increment` right after fail_decision)
+    new_attempt = ex[:, S.X_DEC_ATTEMPT] + 1
+    ex = _set(ex, S.X_DEC_VERSION, increment, ex[:, S.X_CUR_VERSION])
+    ex = _set(ex, S.X_DEC_SCHEDULE_ID, increment, batch_first)
+    ex = _set(ex, S.X_DEC_STARTED_ID, increment, EMPTY_EVENT_ID)
+    ex = _set(ex, S.X_DEC_TIMEOUT, increment, ex[:, S.X_DECISION_TIMEOUT_VALUE])
+    ex = _set(ex, S.X_DEC_ATTEMPT, increment, new_attempt)
+    ex = _set(ex, S.X_DEC_SCHEDULED_TS, increment, ts)
+    ex = _set(ex, S.X_DEC_STARTED_TS, increment, 0)
+    ex = _set(ex, S.X_DEC_ORIGINAL_SCHEDULED_TS, increment, 0)
+
+    ex = _set(ex, S.X_DEC_VERSION, no_increment, EMPTY_VERSION)
+    ex = _set(ex, S.X_DEC_SCHEDULE_ID, no_increment, EMPTY_EVENT_ID)
+    ex = _set(ex, S.X_DEC_STARTED_ID, no_increment, EMPTY_EVENT_ID)
+    for col in (S.X_DEC_TIMEOUT, S.X_DEC_ATTEMPT, S.X_DEC_SCHEDULED_TS,
+                S.X_DEC_STARTED_TS, S.X_DEC_ORIGINAL_SCHEDULED_TS):
+        ex = _set(ex, col, no_increment, 0)
+
+    # ---- pending activities ------------------------------------------------
+    acts = state.activities
+    cap_a = acts.shape[1]
+
+    oh_sched = _slot_mask(ev, m(E.ActivityTaskScheduled), cap_a)
+    zero = jnp.zeros_like(ev_id)
+    # expiration: scheduled + max(schedule_to_close, retry expiration if
+    # larger) — mutableStateBuilder.go:2012-2022
+    exp_interval = jnp.where((a5 > 0) & (a6 > a2), a6, a2)
+    sched_row = jnp.stack([
+        jnp.ones_like(ev_id),          # AC_OCC
+        version,                       # AC_VERSION
+        ev_id,                         # AC_SCHEDULE_ID
+        batch_first,                   # AC_SCHEDULED_BATCH_ID
+        ts,                            # AC_SCHEDULED_TS
+        jnp.full_like(ev_id, EMPTY_EVENT_ID),  # AC_STARTED_ID
+        zero,                          # AC_STARTED_TS
+        a0,                            # AC_ID_HASH
+        a1,                            # AC_SCH_TO_START
+        a2,                            # AC_SCH_TO_CLOSE
+        a3,                            # AC_START_TO_CLOSE
+        a4,                            # AC_HEARTBEAT
+        zero,                          # AC_CANCEL_REQUESTED
+        jnp.full_like(ev_id, EMPTY_EVENT_ID),  # AC_CANCEL_REQUEST_ID
+        zero,                          # AC_ATTEMPT
+        a5,                            # AC_HAS_RETRY
+        ts + exp_interval,             # AC_EXPIRATION_TS
+        zero,                          # AC_LAST_HB_TS
+        zero,                          # AC_TIMER_STATUS
+    ], axis=-1)
+    acts = _blend_rows(acts, oh_sched, sched_row)
+
+    oh_start = _slot_mask(ev, m(E.ActivityTaskStarted), cap_a)
+    acts = _set_cell(acts, oh_start, S.AC_VERSION, version)
+    acts = _set_cell(acts, oh_start, S.AC_STARTED_ID, ev_id)
+    acts = _set_cell(acts, oh_start, S.AC_STARTED_TS, ts)
+    acts = _set_cell(acts, oh_start, S.AC_LAST_HB_TS, ts)
+    acts = _set_cell(acts, oh_start, S.AC_ATTEMPT, a1)
+
+    oh_aclose = _slot_mask(
+        ev,
+        m(E.ActivityTaskCompleted, E.ActivityTaskFailed,
+          E.ActivityTaskTimedOut, E.ActivityTaskCanceled),
+        cap_a,
+    )
+    acts = _clear_rows(acts, oh_aclose)
+
+    oh_acreq = _slot_mask(ev, m(E.ActivityTaskCancelRequested), cap_a)
+    acts = _set_cell(acts, oh_acreq, S.AC_VERSION, version)
+    acts = _set_cell(acts, oh_acreq, S.AC_CANCEL_REQUESTED, jnp.ones_like(ev_id))
+    acts = _set_cell(acts, oh_acreq, S.AC_CANCEL_REQUEST_ID, ev_id)
+
+    # ---- pending timers ----------------------------------------------------
+    timers = state.timers
+    cap_t = timers.shape[1]
+    oh_tstart = _slot_mask(ev, m(E.TimerStarted), cap_t)
+    timer_row = jnp.stack([
+        jnp.ones_like(ev_id),   # TI_OCC
+        version,                # TI_VERSION
+        ev_id,                  # TI_STARTED_ID
+        a0,                     # TI_ID_HASH
+        ts + a1,                # TI_EXPIRY_TS
+        zero,                   # TI_STATUS
+    ], axis=-1)
+    timers = _blend_rows(timers, oh_tstart, timer_row)
+    timers = _clear_rows(
+        timers, _slot_mask(ev, m(E.TimerFired, E.TimerCanceled), cap_t)
+    )
+
+    # ---- pending children --------------------------------------------------
+    children = state.children
+    cap_c = children.shape[1]
+    oh_cinit = _slot_mask(ev, m(E.StartChildWorkflowExecutionInitiated), cap_c)
+    child_row = jnp.stack([
+        jnp.ones_like(ev_id),   # CH_OCC
+        version,                # CH_VERSION
+        ev_id,                  # CH_INITIATED_ID
+        batch_first,            # CH_INITIATED_BATCH_ID
+        jnp.full_like(ev_id, EMPTY_EVENT_ID),  # CH_STARTED_ID
+        a0,                     # CH_WF_ID_HASH
+        zero,                   # CH_RUN_ID_HASH
+        a1,                     # CH_POLICY
+    ], axis=-1)
+    children = _blend_rows(children, oh_cinit, child_row)
+
+    oh_cstart = _slot_mask(ev, m(E.ChildWorkflowExecutionStarted), cap_c)
+    children = _set_cell(children, oh_cstart, S.CH_STARTED_ID, ev_id)
+    children = _set_cell(children, oh_cstart, S.CH_RUN_ID_HASH, a1)
+
+    children = _clear_rows(children, _slot_mask(
+        ev,
+        m(E.StartChildWorkflowExecutionFailed,
+          E.ChildWorkflowExecutionCompleted, E.ChildWorkflowExecutionFailed,
+          E.ChildWorkflowExecutionCanceled, E.ChildWorkflowExecutionTimedOut,
+          E.ChildWorkflowExecutionTerminated),
+        cap_c,
+    ))
+
+    # ---- pending external cancels / signals --------------------------------
+    cancels = state.cancels
+    cap_rc = cancels.shape[1]
+    rc_row = jnp.stack([jnp.ones_like(ev_id), version, ev_id, batch_first], axis=-1)
+    cancels = _blend_rows(
+        cancels,
+        _slot_mask(ev, m(E.RequestCancelExternalWorkflowExecutionInitiated), cap_rc),
+        rc_row,
+    )
+    cancels = _clear_rows(cancels, _slot_mask(
+        ev,
+        m(E.RequestCancelExternalWorkflowExecutionFailed,
+          E.ExternalWorkflowExecutionCancelRequested),
+        cap_rc,
+    ))
+
+    signals = state.signals
+    cap_sg = signals.shape[1]
+    sg_row = jnp.stack([jnp.ones_like(ev_id), version, ev_id, batch_first], axis=-1)
+    signals = _blend_rows(
+        signals,
+        _slot_mask(ev, m(E.SignalExternalWorkflowExecutionInitiated), cap_sg),
+        sg_row,
+    )
+    signals = _clear_rows(signals, _slot_mask(
+        ev,
+        m(E.SignalExternalWorkflowExecutionFailed,
+          E.ExternalWorkflowExecutionSignaled),
+        cap_sg,
+    ))
+
+    return S.StateTensors(
+        exec_info=ex, activities=acts, timers=timers, children=children,
+        cancels=cancels, signals=signals, vh_items=vh_items, vh_len=vh_len,
+    )
+
+
+def replay_scan(state: S.StateTensors, events_tm: jnp.ndarray) -> S.StateTensors:
+    """Scan the full (time-major [T, B, EV_N]) event tensor."""
+    final, _ = lax.scan(
+        lambda s, ev: (replay_step(s, ev), None), state, events_tm
+    )
+    return final
+
+
+replay_scan_jit = jax.jit(replay_scan, donate_argnums=(0,))
+
+
+def replay_packed(
+    packed: PackedHistories,
+    initial: Optional[S.StateTensors] = None,
+) -> S.StateTensors:
+    """Replay a packed batch on the default device; returns numpy state."""
+    state = initial if initial is not None else S.empty_state(packed.batch, packed.caps)
+    state = jax.tree_util.tree_map(jnp.asarray, state)
+    events_tm = jnp.asarray(packed.time_major())
+    final = replay_scan_jit(state, events_tm)
+    return jax.tree_util.tree_map(np.asarray, final)

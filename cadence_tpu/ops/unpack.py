@@ -1,0 +1,368 @@
+"""Unpack device state tensors back into host snapshots.
+
+Two converters produce the same canonical "replay snapshot" dict:
+
+  * ``state_row_to_snapshot`` — from kernel output (StateTensors row +
+    packer side table),
+  * ``mutable_state_to_snapshot`` — from the host oracle's MutableState,
+
+so differential tests compare them with ``==``. The canonical form uses
+second-granularity timestamps (the device ABI) and int31 hashes for
+string-keyed fields; timer-task dedup status is excluded (refreshed
+post-replay by ops/refresh.py on both paths — mirroring the reference's
+taskRefresher after nDCStateRebuilder.rebuild).
+
+``state_row_to_mutable_state`` additionally rehydrates a full MutableState
+(strings from the side table) for the host runtime to persist — the device
+path's equivalent of nDCStateRebuilder returning a rebuilt mutableState.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from cadence_tpu.core.ids import EMPTY_EVENT_ID
+from cadence_tpu.core.mutable_state import (
+    ActivityInfo,
+    ChildExecutionInfo,
+    MutableState,
+    RequestCancelInfo,
+    SignalInfo,
+    TimerInfo,
+)
+from cadence_tpu.core.enums import CloseStatus, ParentClosePolicy, WorkflowState
+from cadence_tpu.core.version_history import VersionHistories, VersionHistory, VersionHistoryItem
+from cadence_tpu.utils.hashing import hash31
+
+from . import schema as S
+from .pack import SECONDS, WorkflowSideTable
+
+_EXEC_FIELDS = [
+    ("state", S.X_STATE),
+    ("close_status", S.X_CLOSE_STATUS),
+    ("next_event_id", S.X_NEXT_EVENT_ID),
+    ("last_first_event_id", S.X_LAST_FIRST_EVENT_ID),
+    ("last_event_task_id", S.X_LAST_EVENT_TASK_ID),
+    ("last_processed_event", S.X_LAST_PROCESSED_EVENT),
+    ("start_ts", S.X_START_TS),
+    ("workflow_timeout", S.X_WORKFLOW_TIMEOUT),
+    ("decision_timeout_value", S.X_DECISION_TIMEOUT_VALUE),
+    ("dec_version", S.X_DEC_VERSION),
+    ("dec_schedule_id", S.X_DEC_SCHEDULE_ID),
+    ("dec_started_id", S.X_DEC_STARTED_ID),
+    ("dec_timeout", S.X_DEC_TIMEOUT),
+    ("dec_attempt", S.X_DEC_ATTEMPT),
+    ("dec_scheduled_ts", S.X_DEC_SCHEDULED_TS),
+    ("dec_started_ts", S.X_DEC_STARTED_TS),
+    ("dec_original_scheduled_ts", S.X_DEC_ORIGINAL_SCHEDULED_TS),
+    ("cancel_requested", S.X_CANCEL_REQUESTED),
+    ("signal_count", S.X_SIGNAL_COUNT),
+    ("attempt", S.X_ATTEMPT),
+    ("has_retry_policy", S.X_HAS_RETRY_POLICY),
+    ("completion_event_batch_id", S.X_COMPLETION_EVENT_BATCH_ID),
+    ("parent_initiated_id", S.X_PARENT_INITIATED_ID),
+    ("wf_expiration_ts", S.X_WF_EXPIRATION_TS),
+    ("cur_version", S.X_CUR_VERSION),
+]
+
+
+def state_row_to_snapshot(state: S.StateTensors, b: int) -> Dict[str, Any]:
+    """Canonical snapshot of workflow ``b`` from kernel output."""
+    ex = np.asarray(state.exec_info[b])
+    snap: Dict[str, Any] = {"exec": {k: int(ex[c]) for k, c in _EXEC_FIELDS}}
+
+    acts = {}
+    for row in np.asarray(state.activities[b]):
+        if row[S.AC_OCC]:
+            acts[int(row[S.AC_SCHEDULE_ID])] = {
+                "version": int(row[S.AC_VERSION]),
+                "scheduled_event_batch_id": int(row[S.AC_SCHEDULED_BATCH_ID]),
+                "scheduled_ts": int(row[S.AC_SCHEDULED_TS]),
+                "started_id": int(row[S.AC_STARTED_ID]),
+                "started_ts": int(row[S.AC_STARTED_TS]),
+                "id_hash": int(row[S.AC_ID_HASH]),
+                "schedule_to_start": int(row[S.AC_SCH_TO_START]),
+                "schedule_to_close": int(row[S.AC_SCH_TO_CLOSE]),
+                "start_to_close": int(row[S.AC_START_TO_CLOSE]),
+                "heartbeat": int(row[S.AC_HEARTBEAT]),
+                "cancel_requested": int(row[S.AC_CANCEL_REQUESTED]),
+                "cancel_request_id": int(row[S.AC_CANCEL_REQUEST_ID]),
+                "attempt": int(row[S.AC_ATTEMPT]),
+                "has_retry": int(row[S.AC_HAS_RETRY]),
+                "expiration_ts": int(row[S.AC_EXPIRATION_TS]),
+                "last_hb_ts": int(row[S.AC_LAST_HB_TS]),
+            }
+    snap["activities"] = acts
+
+    timers = {}
+    for row in np.asarray(state.timers[b]):
+        if row[S.TI_OCC]:
+            timers[int(row[S.TI_STARTED_ID])] = {
+                "version": int(row[S.TI_VERSION]),
+                "id_hash": int(row[S.TI_ID_HASH]),
+                "expiry_ts": int(row[S.TI_EXPIRY_TS]),
+            }
+    snap["timers"] = timers
+
+    children = {}
+    for row in np.asarray(state.children[b]):
+        if row[S.CH_OCC]:
+            children[int(row[S.CH_INITIATED_ID])] = {
+                "version": int(row[S.CH_VERSION]),
+                "initiated_event_batch_id": int(row[S.CH_INITIATED_BATCH_ID]),
+                "started_id": int(row[S.CH_STARTED_ID]),
+                "wf_id_hash": int(row[S.CH_WF_ID_HASH]),
+                "run_id_hash": int(row[S.CH_RUN_ID_HASH]),
+                "policy": int(row[S.CH_POLICY]),
+            }
+    snap["children"] = children
+
+    for name, table, occ_col, init_col, ver_col, batch_col in (
+        ("cancels", state.cancels, S.RC_OCC, S.RC_INITIATED_ID, S.RC_VERSION, S.RC_INITIATED_BATCH_ID),
+        ("signals", state.signals, S.SG_OCC, S.SG_INITIATED_ID, S.SG_VERSION, S.SG_INITIATED_BATCH_ID),
+    ):
+        entries = {}
+        for row in np.asarray(table[b]):
+            if row[occ_col]:
+                entries[int(row[init_col])] = {
+                    "version": int(row[ver_col]),
+                    "initiated_event_batch_id": int(row[batch_col]),
+                }
+        snap[name] = entries
+
+    n = int(state.vh_len[b])
+    snap["version_history"] = [
+        (int(e), int(v)) for e, v in np.asarray(state.vh_items[b][:n])
+    ]
+    return snap
+
+
+def mutable_state_to_snapshot(ms: MutableState) -> Dict[str, Any]:
+    """Same canonical form, from the host oracle."""
+    ei = ms.execution_info
+    s = lambda ns: ns // SECONDS
+    snap: Dict[str, Any] = {
+        "exec": {
+            "state": int(ei.state),
+            "close_status": int(ei.close_status),
+            "next_event_id": ei.next_event_id,
+            "last_first_event_id": ei.last_first_event_id,
+            "last_event_task_id": ei.last_event_task_id,
+            "last_processed_event": ei.last_processed_event,
+            "start_ts": s(ei.start_timestamp),
+            "workflow_timeout": ei.workflow_timeout,
+            "decision_timeout_value": ei.decision_timeout_value,
+            "dec_version": ei.decision_version,
+            "dec_schedule_id": ei.decision_schedule_id,
+            "dec_started_id": ei.decision_started_id,
+            "dec_timeout": ei.decision_timeout,
+            "dec_attempt": ei.decision_attempt,
+            "dec_scheduled_ts": s(ei.decision_scheduled_timestamp),
+            "dec_started_ts": s(ei.decision_started_timestamp),
+            "dec_original_scheduled_ts": s(ei.decision_original_scheduled_timestamp),
+            "cancel_requested": int(ei.cancel_requested),
+            "signal_count": ei.signal_count,
+            "attempt": ei.attempt,
+            "has_retry_policy": int(ei.has_retry_policy),
+            "completion_event_batch_id": ei.completion_event_batch_id,
+            "parent_initiated_id": ei.initiated_id,
+            "wf_expiration_ts": s(ei.expiration_time),
+            "cur_version": ms.current_version,
+        },
+        "activities": {
+            sid: {
+                "version": ai.version,
+                "scheduled_event_batch_id": ai.scheduled_event_batch_id,
+                "scheduled_ts": s(ai.scheduled_time),
+                "started_id": ai.started_id,
+                "started_ts": s(ai.started_time),
+                "id_hash": hash31(ai.activity_id),
+                "schedule_to_start": ai.schedule_to_start_timeout,
+                "schedule_to_close": ai.schedule_to_close_timeout,
+                "start_to_close": ai.start_to_close_timeout,
+                "heartbeat": ai.heartbeat_timeout,
+                "cancel_requested": int(ai.cancel_requested),
+                "cancel_request_id": ai.cancel_request_id,
+                "attempt": ai.attempt,
+                "has_retry": int(ai.has_retry_policy),
+                "expiration_ts": s(ai.expiration_time),
+                "last_hb_ts": s(ai.last_heartbeat_updated_time),
+            }
+            for sid, ai in ms.pending_activities.items()
+        },
+        "timers": {
+            ti.started_id: {
+                "version": ti.version,
+                "id_hash": hash31(ti.timer_id),
+                "expiry_ts": s(ti.expiry_time),
+            }
+            for ti in ms.pending_timers.values()
+        },
+        "children": {
+            cid: {
+                "version": ci.version,
+                "initiated_event_batch_id": ci.initiated_event_batch_id,
+                "started_id": ci.started_id,
+                "wf_id_hash": hash31(ci.started_workflow_id),
+                "run_id_hash": hash31(ci.started_run_id) if ci.started_run_id else 0,
+                "policy": int(ci.parent_close_policy),
+            }
+            for cid, ci in ms.pending_children.items()
+        },
+        "cancels": {
+            rid: {
+                "version": rc.version,
+                "initiated_event_batch_id": rc.initiated_event_batch_id,
+            }
+            for rid, rc in ms.pending_request_cancels.items()
+        },
+        "signals": {
+            sid: {
+                "version": si.version,
+                "initiated_event_batch_id": si.initiated_event_batch_id,
+            }
+            for sid, si in ms.pending_signals.items()
+        },
+        "version_history": (
+            [
+                (it.event_id, it.version)
+                for it in ms.version_histories.get_current_version_history().items
+            ]
+            if ms.version_histories is not None
+            else []
+        ),
+    }
+    return snap
+
+
+def state_row_to_mutable_state(
+    state: S.StateTensors, b: int, side: WorkflowSideTable,
+    domain_id: str = "",
+) -> MutableState:
+    """Rehydrate a full MutableState from kernel output + side table."""
+    ex = np.asarray(state.exec_info[b])
+    ms = MutableState(domain_id=domain_id, current_version=int(ex[S.X_CUR_VERSION]))
+    ei = ms.execution_info
+    ei.workflow_id = side.workflow_id
+    ei.run_id = side.run_id
+    ei.create_request_id = side.request_id
+    ei.task_list = side.task_list
+    ei.workflow_type_name = side.workflow_type
+    ei.cron_schedule = side.cron_schedule
+    ei.parent_domain_id = side.parent_domain
+    ei.parent_workflow_id = side.parent_workflow_id
+    ei.parent_run_id = side.parent_run_id
+    ei.memo = dict(side.memo)
+    ei.search_attributes = dict(side.search_attributes)
+    ei.state = WorkflowState(int(ex[S.X_STATE]))
+    ei.close_status = CloseStatus(int(ex[S.X_CLOSE_STATUS]))
+    ei.next_event_id = int(ex[S.X_NEXT_EVENT_ID])
+    ei.last_first_event_id = int(ex[S.X_LAST_FIRST_EVENT_ID])
+    ei.last_event_task_id = int(ex[S.X_LAST_EVENT_TASK_ID])
+    ei.last_processed_event = int(ex[S.X_LAST_PROCESSED_EVENT])
+    ei.start_timestamp = int(ex[S.X_START_TS]) * SECONDS
+    ei.workflow_timeout = int(ex[S.X_WORKFLOW_TIMEOUT])
+    ei.decision_timeout_value = int(ex[S.X_DECISION_TIMEOUT_VALUE])
+    ei.decision_version = int(ex[S.X_DEC_VERSION])
+    ei.decision_schedule_id = int(ex[S.X_DEC_SCHEDULE_ID])
+    ei.decision_started_id = int(ex[S.X_DEC_STARTED_ID])
+    ei.decision_timeout = int(ex[S.X_DEC_TIMEOUT])
+    ei.decision_attempt = int(ex[S.X_DEC_ATTEMPT])
+    ei.decision_scheduled_timestamp = int(ex[S.X_DEC_SCHEDULED_TS]) * SECONDS
+    ei.decision_started_timestamp = int(ex[S.X_DEC_STARTED_TS]) * SECONDS
+    ei.decision_original_scheduled_timestamp = (
+        int(ex[S.X_DEC_ORIGINAL_SCHEDULED_TS]) * SECONDS
+    )
+    ei.cancel_requested = bool(ex[S.X_CANCEL_REQUESTED])
+    ei.signal_count = int(ex[S.X_SIGNAL_COUNT])
+    ei.attempt = int(ex[S.X_ATTEMPT])
+    ei.has_retry_policy = bool(ex[S.X_HAS_RETRY_POLICY])
+    ei.completion_event_batch_id = int(ex[S.X_COMPLETION_EVENT_BATCH_ID])
+    ei.initiated_id = int(ex[S.X_PARENT_INITIATED_ID])
+    ei.expiration_time = int(ex[S.X_WF_EXPIRATION_TS]) * SECONDS
+
+    for slot, row in enumerate(np.asarray(state.activities[b])):
+        if not row[S.AC_OCC]:
+            continue
+        activity_id = side.activity_ids.get(slot, "")
+        ai = ActivityInfo(
+            version=int(row[S.AC_VERSION]),
+            schedule_id=int(row[S.AC_SCHEDULE_ID]),
+            scheduled_event_batch_id=int(row[S.AC_SCHEDULED_BATCH_ID]),
+            scheduled_time=int(row[S.AC_SCHEDULED_TS]) * SECONDS,
+            started_id=int(row[S.AC_STARTED_ID]),
+            started_time=int(row[S.AC_STARTED_TS]) * SECONDS,
+            activity_id=activity_id,
+            schedule_to_start_timeout=int(row[S.AC_SCH_TO_START]),
+            schedule_to_close_timeout=int(row[S.AC_SCH_TO_CLOSE]),
+            start_to_close_timeout=int(row[S.AC_START_TO_CLOSE]),
+            heartbeat_timeout=int(row[S.AC_HEARTBEAT]),
+            cancel_requested=bool(row[S.AC_CANCEL_REQUESTED]),
+            cancel_request_id=int(row[S.AC_CANCEL_REQUEST_ID]),
+            attempt=int(row[S.AC_ATTEMPT]),
+            has_retry_policy=bool(row[S.AC_HAS_RETRY]),
+            expiration_time=int(row[S.AC_EXPIRATION_TS]) * SECONDS,
+            last_heartbeat_updated_time=int(row[S.AC_LAST_HB_TS]) * SECONDS,
+            task_list=side.activity_task_lists.get(slot, ""),
+        )
+        ms.pending_activities[ai.schedule_id] = ai
+        ms.activity_by_id[ai.activity_id] = ai.schedule_id
+
+    for slot, row in enumerate(np.asarray(state.timers[b])):
+        if not row[S.TI_OCC]:
+            continue
+        timer_id = side.timer_ids.get(slot, "")
+        ti = TimerInfo(
+            version=int(row[S.TI_VERSION]),
+            timer_id=timer_id,
+            started_id=int(row[S.TI_STARTED_ID]),
+            expiry_time=int(row[S.TI_EXPIRY_TS]) * SECONDS,
+        )
+        ms.pending_timers[timer_id] = ti
+        ms.timer_by_started_id[ti.started_id] = timer_id
+
+    for slot, row in enumerate(np.asarray(state.children[b])):
+        if not row[S.CH_OCC]:
+            continue
+        ci = ChildExecutionInfo(
+            version=int(row[S.CH_VERSION]),
+            initiated_id=int(row[S.CH_INITIATED_ID]),
+            initiated_event_batch_id=int(row[S.CH_INITIATED_BATCH_ID]),
+            started_id=int(row[S.CH_STARTED_ID]),
+            started_workflow_id=side.child_workflow_ids.get(slot, ""),
+            started_run_id=side.child_run_ids.get(slot, ""),
+            domain_name=side.child_domains.get(slot, ""),
+            workflow_type_name=side.child_types.get(slot, ""),
+            parent_close_policy=ParentClosePolicy(int(row[S.CH_POLICY])),
+        )
+        ms.pending_children[ci.initiated_id] = ci
+
+    for row in np.asarray(state.cancels[b]):
+        if row[S.RC_OCC]:
+            rc = RequestCancelInfo(
+                version=int(row[S.RC_VERSION]),
+                initiated_id=int(row[S.RC_INITIATED_ID]),
+                initiated_event_batch_id=int(row[S.RC_INITIATED_BATCH_ID]),
+            )
+            ms.pending_request_cancels[rc.initiated_id] = rc
+
+    for row in np.asarray(state.signals[b]):
+        if row[S.SG_OCC]:
+            si = SignalInfo(
+                version=int(row[S.SG_VERSION]),
+                initiated_id=int(row[S.SG_INITIATED_ID]),
+                initiated_event_batch_id=int(row[S.SG_INITIATED_BATCH_ID]),
+            )
+            ms.pending_signals[si.initiated_id] = si
+
+    n = int(state.vh_len[b])
+    vh = VersionHistory(
+        items=[
+            VersionHistoryItem(int(e), int(v))
+            for e, v in np.asarray(state.vh_items[b][:n])
+        ]
+    )
+    ms.version_histories = VersionHistories([vh], 0)
+    return ms

@@ -1,0 +1,1 @@
+"""Shared utilities: hashing, clock, backoff, config, metrics, logging."""

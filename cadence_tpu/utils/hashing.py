@@ -1,0 +1,33 @@
+"""Stable 32-bit string hashing.
+
+Used for (a) workflowID → shard routing (the reference uses
+farm.Fingerprint32, /root/reference/common/util.go:249-251 — we use FNV-1a,
+any stable uniform 32-bit hash serves the contract) and (b) string →
+int32 slot keys during tensor packing (activity IDs, timer IDs), since
+on-device transitions never need the string itself.
+"""
+
+from __future__ import annotations
+
+_FNV_OFFSET = 2166136261
+_FNV_PRIME = 16777619
+_MASK32 = 0xFFFFFFFF
+
+
+def fnv1a32(s: str) -> int:
+    """FNV-1a over utf-8 bytes, full uint32 range."""
+    h = _FNV_OFFSET
+    for byte in s.encode("utf-8"):
+        h ^= byte
+        h = (h * _FNV_PRIME) & _MASK32
+    return h
+
+
+def hash31(s: str) -> int:
+    """Non-negative int31 hash — safe to store in an int32 tensor."""
+    return fnv1a32(s) & 0x7FFFFFFF
+
+
+def shard_for_workflow(workflow_id: str, num_shards: int) -> int:
+    """workflowID → shard (reference: common/util.go:249-251)."""
+    return fnv1a32(workflow_id) % num_shards

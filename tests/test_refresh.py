@@ -1,0 +1,48 @@
+"""Parity: device task refresher vs host task refresher.
+
+Both implement the reference's taskRefresher semantics
+(mutableStateTaskRefresher.go); after any replay the outstanding task set
+must be identical whichever side computed it.
+"""
+
+import pytest
+
+from cadence_tpu.core.task_refresher import refresh_tasks
+from cadence_tpu.ops.pack import pack_histories
+from cadence_tpu.ops.refresh import (
+    hydrate_tasks,
+    refresh_tasks_device_jit,
+    refreshed_to_numpy,
+)
+from cadence_tpu.ops.replay import replay_packed
+
+from test_replay_differential import ALL_SCENARIOS, oracle_replay
+
+
+@pytest.mark.parametrize("scenario", ALL_SCENARIOS, ids=lambda f: f.__name__)
+def test_refresh_parity(scenario):
+    batches = scenario()
+    packed = pack_histories([("wf", "run", batches)])
+    final = replay_packed(packed)
+    refreshed = refreshed_to_numpy(refresh_tasks_device_jit(final))
+    dev_transfer, dev_timer = hydrate_tasks(refreshed, 0, packed, domain_id="dom")
+
+    ms = oracle_replay(batches)
+    host_transfer, host_timer = refresh_tasks(ms)
+
+    assert [
+        (t.task_type, t.schedule_id, t.task_list, t.initiated_id)
+        for t in dev_transfer
+    ] == [
+        (t.task_type, t.schedule_id, t.task_list, t.initiated_id)
+        for t in host_transfer
+    ]
+    assert [
+        (t.task_type, t.visibility_timestamp, t.timeout_type, t.event_id,
+         t.schedule_attempt, t.version)
+        for t in dev_timer
+    ] == [
+        (t.task_type, t.visibility_timestamp, t.timeout_type, t.event_id,
+         t.schedule_attempt, t.version)
+        for t in host_timer
+    ]

@@ -1,0 +1,302 @@
+"""Differential tests: TPU replay kernel vs host oracle, field for field.
+
+The contract (SURVEY.md §7.2): pack histories → scan on device → unpack →
+identical canonical snapshot to replaying the same batches through
+``StateBuilder.apply_events`` host-side.
+"""
+
+import pytest
+
+from cadence_tpu.core import history_factory as F
+from cadence_tpu.core.enums import ParentClosePolicy, TimeoutType
+from cadence_tpu.core.mutable_state import MutableState, SECOND
+from cadence_tpu.core.state_builder import StateBuilder
+from cadence_tpu.core.version_history import VersionHistories
+from cadence_tpu.ops import schema as S
+from cadence_tpu.ops.pack import PackOverflowError, pack_histories, pack_workflow
+from cadence_tpu.ops.replay import replay_packed
+from cadence_tpu.ops.unpack import mutable_state_to_snapshot, state_row_to_snapshot
+
+T0 = 1_700_000_000 * SECOND
+V = 10
+
+
+def oracle_replay(batches, domain_id="dom", workflow_id="wf", run_id="run"):
+    ms = MutableState(domain_id=domain_id)
+    ms.version_histories = VersionHistories.new_empty()
+    sb = StateBuilder(ms, id_generator=lambda: "fixed")
+    for batch in batches:
+        new_run = None
+        sb.apply_events(domain_id, "req", workflow_id, run_id, list(batch), new_run)
+    return ms
+
+
+def assert_parity(batches_per_workflow):
+    """Replay every workflow both ways and compare snapshots."""
+    histories = [
+        (f"wf-{i}", f"run-{i}", batches)
+        for i, batches in enumerate(batches_per_workflow)
+    ]
+    packed = pack_histories(histories)
+    final = replay_packed(packed)
+    for i, (_, _, batches) in enumerate(histories):
+        kernel_snap = state_row_to_snapshot(final, i)
+        oracle_snap = mutable_state_to_snapshot(
+            oracle_replay(batches, workflow_id=f"wf-{i}", run_id=f"run-{i}")
+        )
+        assert kernel_snap == oracle_snap, (
+            f"workflow {i} diverged:\nkernel={kernel_snap}\noracle={oracle_snap}"
+        )
+
+
+def echo_batches(t=T0):
+    return [
+        [F.workflow_execution_started(1, V, t, task_list="tl", workflow_type="echo")],
+        [F.decision_task_scheduled(2, V, t + SECOND)],
+        [F.decision_task_started(3, V, t + 2 * SECOND, scheduled_event_id=2)],
+        [
+            F.decision_task_completed(4, V, t + 3 * SECOND, scheduled_event_id=2,
+                                      started_event_id=3),
+            F.activity_task_scheduled(5, V, t + 3 * SECOND, activity_id="a1",
+                                      heartbeat_timeout_seconds=3),
+        ],
+        [F.activity_task_started(6, V, t + 4 * SECOND, scheduled_event_id=5)],
+        [F.activity_task_completed(7, V, t + 5 * SECOND, scheduled_event_id=5,
+                                   started_event_id=6),
+         F.decision_task_scheduled(8, V, t + 5 * SECOND)],
+        [F.decision_task_started(9, V, t + 6 * SECOND, scheduled_event_id=8)],
+        [
+            F.decision_task_completed(10, V, t + 7 * SECOND, scheduled_event_id=8,
+                                      started_event_id=9),
+            F.workflow_execution_completed(11, V, t + 7 * SECOND,
+                                           decision_task_completed_event_id=10),
+        ],
+    ]
+
+
+def timer_batches(t=T0):
+    return [
+        [F.workflow_execution_started(1, V, t)],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_started(3, V, t, scheduled_event_id=2)],
+        [
+            F.decision_task_completed(4, V, t + SECOND, scheduled_event_id=2,
+                                      started_event_id=3),
+            F.timer_started(5, V, t + SECOND, timer_id="t1",
+                            start_to_fire_timeout_seconds=30),
+            F.timer_started(6, V, t + SECOND, timer_id="t2",
+                            start_to_fire_timeout_seconds=10),
+        ],
+        [F.timer_fired(7, V, t + 11 * SECOND, timer_id="t2", started_event_id=6),
+         F.decision_task_scheduled(8, V, t + 11 * SECOND)],
+        [F.decision_task_started(9, V, t + 12 * SECOND, scheduled_event_id=8)],
+        [
+            F.decision_task_completed(10, V, t + 13 * SECOND, scheduled_event_id=8,
+                                      started_event_id=9),
+            F.timer_canceled(11, V, t + 13 * SECOND, timer_id="t1",
+                             started_event_id=5,
+                             decision_task_completed_event_id=10),
+        ],
+    ]
+
+
+def signal_cancel_batches(t=T0):
+    return [
+        [F.workflow_execution_started(1, V, t)],
+        [F.workflow_execution_signaled(2, V, t + SECOND, signal_name="s1")],
+        [F.workflow_execution_signaled(3, V, t + SECOND, signal_name="s2")],
+        [F.workflow_execution_cancel_requested(4, V, t + 2 * SECOND)],
+        [F.decision_task_scheduled(5, V, t + 2 * SECOND)],
+        [F.decision_task_started(6, V, t + 3 * SECOND, scheduled_event_id=5)],
+        [
+            F.decision_task_completed(7, V, t + 4 * SECOND, scheduled_event_id=5,
+                                      started_event_id=6),
+            F.workflow_execution_canceled(8, V, t + 4 * SECOND,
+                                          decision_task_completed_event_id=7),
+        ],
+    ]
+
+
+def decision_failure_batches(t=T0):
+    return [
+        [F.workflow_execution_started(1, V, t)],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_started(3, V, t + SECOND, scheduled_event_id=2)],
+        [F.decision_task_timed_out(4, V, t + 20 * SECOND, scheduled_event_id=2,
+                                   started_event_id=3)],
+        # transient decision now pending (attempt=1, schedule_id from batch)
+        [F.decision_task_scheduled(5, V, t + 21 * SECOND, attempt=1)],
+        [F.decision_task_started(6, V, t + 22 * SECOND, scheduled_event_id=5)],
+        [F.decision_task_failed(7, V, t + 23 * SECOND, scheduled_event_id=5,
+                                started_event_id=6)],
+    ]
+
+
+def sticky_timeout_batches(t=T0):
+    return [
+        [F.workflow_execution_started(1, V, t)],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_timed_out(
+            3, V, t + 5 * SECOND, scheduled_event_id=2,
+            timeout_type=TimeoutType.ScheduleToStart)],
+    ]
+
+
+def child_external_batches(t=T0):
+    return [
+        [F.workflow_execution_started(1, V, t)],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_started(3, V, t, scheduled_event_id=2)],
+        [
+            F.decision_task_completed(4, V, t + SECOND, scheduled_event_id=2,
+                                      started_event_id=3),
+            F.start_child_initiated(5, V, t + SECOND, domain="dom",
+                                    workflow_id="child-1",
+                                    parent_close_policy=ParentClosePolicy.RequestCancel,
+                                    decision_task_completed_event_id=4),
+            F.request_cancel_external_initiated(6, V, t + SECOND, domain="dom",
+                                                workflow_id="other-wf",
+                                                decision_task_completed_event_id=4),
+            F.signal_external_initiated(7, V, t + SECOND, domain="dom",
+                                        workflow_id="other-wf",
+                                        decision_task_completed_event_id=4),
+        ],
+        [F.child_execution_started(8, V, t + 2 * SECOND, initiated_event_id=5,
+                                   workflow_id="child-1", run_id="crun-1")],
+        [F.external_workflow_execution_cancel_requested(
+            9, V, t + 2 * SECOND, initiated_event_id=6)],
+        [F.external_workflow_execution_signaled(
+            10, V, t + 3 * SECOND, initiated_event_id=7)],
+        [F.child_execution_completed(11, V, t + 4 * SECOND, initiated_event_id=5,
+                                     started_event_id=8)],
+    ]
+
+
+def activity_storm_batches(t=T0):
+    """Interleaved activity lifecycles incl. cancel-request and timeout."""
+    return [
+        [F.workflow_execution_started(1, V, t)],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_started(3, V, t, scheduled_event_id=2)],
+        [
+            F.decision_task_completed(4, V, t, scheduled_event_id=2,
+                                      started_event_id=3),
+            F.activity_task_scheduled(5, V, t, activity_id="a1"),
+            F.activity_task_scheduled(6, V, t, activity_id="a2",
+                                      schedule_to_start_timeout_seconds=5),
+            F.activity_task_scheduled(7, V, t, activity_id="a3",
+                                      heartbeat_timeout_seconds=2),
+            F.activity_task_cancel_requested(8, V, t, activity_id="a2",
+                                             decision_task_completed_event_id=4),
+        ],
+        [F.activity_task_started(9, V, t + SECOND, scheduled_event_id=5)],
+        [F.activity_task_started(10, V, t + SECOND, scheduled_event_id=7)],
+        [F.activity_task_failed(11, V, t + 2 * SECOND, scheduled_event_id=5,
+                                started_event_id=9, reason="boom")],
+        [F.activity_task_timed_out(12, V, t + 6 * SECOND, scheduled_event_id=6,
+                                   started_event_id=-23,
+                                   timeout_type=TimeoutType.ScheduleToStart)],
+        [F.activity_task_canceled(13, V, t + 6 * SECOND, scheduled_event_id=7,
+                                  started_event_id=10)],
+        # a1 slot is free again: schedule a new activity reusing the id
+        [F.decision_task_scheduled(14, V, t + 6 * SECOND)],
+        [F.decision_task_started(15, V, t + 7 * SECOND, scheduled_event_id=14)],
+        [
+            F.decision_task_completed(16, V, t + 8 * SECOND, scheduled_event_id=14,
+                                      started_event_id=15),
+            F.activity_task_scheduled(17, V, t + 8 * SECOND, activity_id="a1"),
+        ],
+    ]
+
+
+def version_bump_batches(t=T0):
+    """Failover mid-history: version changes across batches (NDC)."""
+    return [
+        [F.workflow_execution_started(1, 10, t)],
+        [F.decision_task_scheduled(2, 10, t)],
+        [F.decision_task_started(3, 10, t, scheduled_event_id=2)],
+        [F.decision_task_timed_out(4, 21, t + 30 * SECOND, scheduled_event_id=2,
+                                   started_event_id=3)],
+        [F.decision_task_scheduled(5, 21, t + 31 * SECOND, attempt=1)],
+        [F.decision_task_started(6, 21, t + 32 * SECOND, scheduled_event_id=5)],
+        [
+            F.decision_task_completed(7, 21, t + 33 * SECOND, scheduled_event_id=5,
+                                      started_event_id=6),
+            F.workflow_execution_completed(8, 21, t + 33 * SECOND,
+                                           decision_task_completed_event_id=7),
+        ],
+    ]
+
+
+ALL_SCENARIOS = [
+    echo_batches,
+    timer_batches,
+    signal_cancel_batches,
+    decision_failure_batches,
+    sticky_timeout_batches,
+    child_external_batches,
+    activity_storm_batches,
+    version_bump_batches,
+]
+
+
+class TestKernelOracleParity:
+    @pytest.mark.parametrize("scenario", ALL_SCENARIOS, ids=lambda f: f.__name__)
+    def test_single(self, scenario):
+        assert_parity([scenario()])
+
+    def test_mixed_batch(self):
+        """All scenarios in one padded, ragged device batch."""
+        assert_parity([fn() for fn in ALL_SCENARIOS])
+
+    def test_batch_padding(self):
+        histories = [("wf", "run", echo_batches())]
+        packed = pack_histories(histories, pad_batch_to=8)
+        assert packed.batch == 8
+        final = replay_packed(packed)
+        snap = state_row_to_snapshot(final, 0)
+        assert snap == mutable_state_to_snapshot(oracle_replay(echo_batches()))
+        # padded rows stay pristine
+        pad = state_row_to_snapshot(final, 7)
+        assert pad["activities"] == {} and pad["version_history"] == []
+        assert pad["exec"]["state"] == 0
+
+
+class TestPackValidation:
+    def test_overflow_raises(self):
+        t = T0
+        caps = S.Capacities(max_activities=2)
+        batches = [
+            [F.workflow_execution_started(1, V, t)],
+            [
+                F.activity_task_scheduled(2, V, t, activity_id="a1"),
+                F.activity_task_scheduled(3, V, t, activity_id="a2"),
+                F.activity_task_scheduled(4, V, t, activity_id="a3"),
+            ],
+        ]
+        with pytest.raises(PackOverflowError):
+            pack_workflow(batches, caps)
+
+    def test_orphan_event_raises(self):
+        t = T0
+        batches = [
+            [F.workflow_execution_started(1, V, t)],
+            [F.activity_task_completed(2, V, t, scheduled_event_id=99,
+                                       started_event_id=98)],
+        ]
+        with pytest.raises(Exception):
+            pack_workflow(batches, S.Capacities())
+
+    def test_slot_reuse_is_deterministic(self):
+        t = T0
+        batches = [
+            [F.workflow_execution_started(1, V, t)],
+            [F.activity_task_scheduled(2, V, t, activity_id="a1"),
+             F.activity_task_scheduled(3, V, t, activity_id="a2")],
+            [F.activity_task_completed(4, V, t, scheduled_event_id=2,
+                                       started_event_id=-23)],
+            [F.activity_task_scheduled(5, V, t, activity_id="a3")],
+        ]
+        arr, side = pack_workflow(batches, S.Capacities())
+        # a3 reuses slot 0 (lowest free)
+        assert side.activity_ids == {0: "a3", 1: "a2"}
