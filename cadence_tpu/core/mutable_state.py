@@ -650,9 +650,14 @@ class MutableState:
     # timers
 
     def replicate_timer_started_event(self, event: HistoryEvent) -> TimerInfo:
-        # reference: mutableStateBuilder.go:2877-2901
+        # reference: mutableStateBuilder.go:2877-2901; a duplicate pending
+        # timer ID is treated as corrupt history (the active path can never
+        # produce one — AddStartTimer validates), keeping host-replay and
+        # pack-time strictness identical.
         a = event.attributes
         timer_id = a.get("timer_id", "")
+        if timer_id in self.pending_timers:
+            raise InvalidHistoryError(f"duplicate pending timer {timer_id!r}")
         ti = TimerInfo(
             version=event.version,
             timer_id=timer_id,

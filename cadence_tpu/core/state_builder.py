@@ -45,6 +45,20 @@ class StateBuilder:
 
     # ------------------------------------------------------------------
 
+    def apply_batches(
+        self,
+        domain_id: str,
+        request_id: str,
+        workflow_id: str,
+        run_id: str,
+        batches: List[List[HistoryEvent]],
+    ) -> None:
+        """Replay a multi-batch history, one apply_events call per
+        transaction batch (the caller-side loop the reference's rebuilder
+        runs, nDCStateRebuilder.go:128-137)."""
+        for batch in batches:
+            self.apply_events(domain_id, request_id, workflow_id, run_id, batch)
+
     def apply_events(
         self,
         domain_id: str,
@@ -54,6 +68,16 @@ class StateBuilder:
         history: List[HistoryEvent],
         new_run_history: Optional[List[HistoryEvent]] = None,
     ) -> Tuple[HistoryEvent, Optional[DecisionInfo], Optional[MutableState]]:
+        """Apply ONE transaction batch of events.
+
+        Contract: ``history`` is a single persisted transaction batch —
+        batch-derived state (scheduled_event_batch_id,
+        completion_event_batch_id, transient-decision schedule IDs, and the
+        batch-end next_event_id update) all key off ``history[0]``. For a
+        multi-batch stream use ``apply_batches``; passing a flat multi-
+        transaction list treats it as one giant batch, which is legal but
+        yields different batch IDs than per-batch replay.
+        """
         if not history:
             raise ValueError("history size is zero")
         first_event = history[0]

@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from cadence_tpu.core.enums import EventType
+from cadence_tpu.core.enums import EventType, TimeoutType
 from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.core.ids import EMPTY_EVENT_ID
 from cadence_tpu.utils.hashing import hash31
@@ -79,12 +79,18 @@ class WorkflowSideTable:
 
 @dataclasses.dataclass
 class PackedHistories:
-    """Batched event tensors + host side tables."""
+    """Batched event tensors + host side tables.
+
+    All on-device timestamps are seconds relative to ``epoch_s`` with a +1
+    offset (0 stays the "unset" sentinel): abs_s = rel + epoch_s - 1. The
+    rebasing keeps every `ts + timeout` sum far from int32 overflow.
+    """
 
     events: np.ndarray        # [B, T, EV_N] int32
     lengths: np.ndarray       # [B] int32 — valid event count per row
     side: List[WorkflowSideTable]
     caps: S.Capacities
+    epoch_s: int = 0
 
     @property
     def batch(self) -> int:
@@ -93,6 +99,13 @@ class PackedHistories:
     def time_major(self) -> np.ndarray:
         """[T, B, EV_N] — the layout lax.scan consumes."""
         return np.ascontiguousarray(np.transpose(self.events, (1, 0, 2)))
+
+
+# Bounds guaranteeing every on-device `rel_ts + timeout` sum fits int32:
+# relative timestamps span < 2^28 s (~8.5 years of history) and individual
+# timeout fields < 2^30 s (~34 years).
+MAX_REL_TS = 2**28
+MAX_TIMEOUT_S = 2**30
 
 
 class _SlotTable:
@@ -128,11 +141,11 @@ class _SlotTable:
         return slot
 
 
-def _ts_seconds(ns: int) -> int:
-    s = ns // SECONDS
-    if not (0 <= s <= _INT32_MAX):
-        raise PackError(f"timestamp {ns} does not fit int32 seconds")
-    return int(s)
+def _timeout(a: Dict[str, Any], key: str) -> int:
+    v = a.get(key, 0) or 0
+    if not (0 <= v < MAX_TIMEOUT_S):
+        raise PackError(f"timeout {key}={v} out of range")
+    return int(v)
 
 
 def pack_workflow(
@@ -141,13 +154,28 @@ def pack_workflow(
     workflow_id: str = "",
     run_id: str = "",
     request_id: str = "",
+    epoch_s: Optional[int] = None,
 ) -> Tuple[np.ndarray, WorkflowSideTable]:
     """Pack one workflow's history (a sequence of transaction batches) into
-    an [n_events, EV_N] int32 array + its side table."""
+    an [n_events, EV_N] int32 array + its side table.
+
+    ``epoch_s``: shared batch epoch (defaults to this workflow's first
+    event); all timestamps become rel = abs_s - epoch_s + 1."""
 
     side = WorkflowSideTable(
         workflow_id=workflow_id, run_id=run_id, request_id=request_id
     )
+    if epoch_s is None:
+        first = next((b[0] for b in batches if b), None)
+        epoch_s = (first.timestamp // SECONDS) if first else 0
+
+    def rel_ts(ns: int) -> int:
+        s = ns // SECONDS - epoch_s + 1
+        if not (1 <= s < MAX_REL_TS):
+            raise PackError(
+                f"timestamp {ns} out of packable window (epoch {epoch_s})"
+            )
+        return int(s)
     acts = _SlotTable(caps.max_activities, "activity")
     acts_by_name: Dict[str, int] = {}  # activity_id → live slot
     timers = _SlotTable(caps.max_timers, "timer")
@@ -164,6 +192,8 @@ def pack_workflow(
 
     version_changes = 0
     last_version: Optional[int] = None
+    next_event_id: Optional[int] = None
+    pending_dec: Optional[int] = None  # decision schedule id currently pending
 
     for batch in batches:
         if not batch:
@@ -174,6 +204,13 @@ def pack_workflow(
             a = ev.attributes
             slot = -1
             attrs = [0] * 8
+
+            if next_event_id is not None and ev.event_id != next_event_id:
+                raise PackError(
+                    f"event id {ev.event_id} breaks contiguity "
+                    f"(expected {next_event_id})"
+                )
+            next_event_id = ev.event_id + 1
 
             if last_version is None or ev.version != last_version:
                 if last_version is not None and ev.version < last_version:
@@ -199,31 +236,46 @@ def pack_workflow(
                 side.memo = dict(a.get("memo") or {})
                 side.search_attributes = dict(a.get("search_attributes") or {})
                 rp = a.get("retry_policy")
-                attrs[0] = a.get("execution_start_to_close_timeout_seconds", 0)
-                attrs[1] = a.get("task_start_to_close_timeout_seconds", 0)
+                attrs[0] = _timeout(a, "execution_start_to_close_timeout_seconds")
+                attrs[1] = _timeout(a, "task_start_to_close_timeout_seconds")
                 attrs[2] = a.get("attempt", 0)
                 attrs[3] = 1 if rp is not None else 0
                 exp = a.get("expiration_timestamp", 0)
-                attrs[4] = _ts_seconds(exp) if exp else 0
-                attrs[5] = a.get("first_decision_task_backoff_seconds", 0)
+                attrs[4] = rel_ts(exp) if exp else 0
+                attrs[5] = _timeout(a, "first_decision_task_backoff_seconds")
                 attrs[6] = a.get("initiator", 0)
                 attrs[7] = a.get("parent_initiated_event_id", EMPTY_EVENT_ID)
 
             elif et == EventType.DecisionTaskScheduled:
-                attrs[0] = a.get("start_to_close_timeout_seconds", 0)
+                attrs[0] = _timeout(a, "start_to_close_timeout_seconds")
                 attrs[1] = a.get("attempt", 0)
+                pending_dec = ev.event_id
 
             elif et == EventType.DecisionTaskStarted:
-                attrs[0] = a.get("scheduled_event_id", EMPTY_EVENT_ID)
+                sched = a.get("scheduled_event_id", EMPTY_EVENT_ID)
+                # same strictness as replicate_decision_task_started_event
+                if pending_dec is None or sched != pending_dec:
+                    raise PackError(
+                        f"decision started references schedule {sched}, "
+                        f"pending is {pending_dec}"
+                    )
+                attrs[0] = sched
 
             elif et == EventType.DecisionTaskCompleted:
                 attrs[0] = a.get("started_event_id", EMPTY_EVENT_ID)
+                pending_dec = None
 
             elif et == EventType.DecisionTaskTimedOut:
                 attrs[0] = a.get("timeout_type", 0)
+                # sticky timeouts drop the decision; others leave a
+                # transient decision pending (schedule id = batch first)
+                if attrs[0] == int(TimeoutType.ScheduleToStart):
+                    pending_dec = None
+                else:
+                    pending_dec = batch_first
 
             elif et == EventType.DecisionTaskFailed:
-                pass
+                pending_dec = batch_first  # transient decision
 
             elif et == EventType.ActivityTaskScheduled:
                 activity_id = a.get("activity_id", "")
@@ -233,12 +285,12 @@ def pack_workflow(
                 side.activity_task_lists[slot] = a.get("task_list", "")
                 rp = a.get("retry_policy")
                 attrs[0] = hash31(activity_id)
-                attrs[1] = a.get("schedule_to_start_timeout_seconds", 0)
-                attrs[2] = a.get("schedule_to_close_timeout_seconds", 0)
-                attrs[3] = a.get("start_to_close_timeout_seconds", 0)
-                attrs[4] = a.get("heartbeat_timeout_seconds", 0)
+                attrs[1] = _timeout(a, "schedule_to_start_timeout_seconds")
+                attrs[2] = _timeout(a, "schedule_to_close_timeout_seconds")
+                attrs[3] = _timeout(a, "start_to_close_timeout_seconds")
+                attrs[4] = _timeout(a, "heartbeat_timeout_seconds")
                 attrs[5] = 1 if rp is not None else 0
-                attrs[6] = (rp or {}).get("expiration_interval_seconds", 0)
+                attrs[6] = _timeout(rp or {}, "expiration_interval_seconds")
 
             elif et == EventType.ActivityTaskStarted:
                 sched = a.get("scheduled_event_id", EMPTY_EVENT_ID)
@@ -282,7 +334,7 @@ def pack_workflow(
                 slot = timers.alloc(timer_id)
                 side.timer_ids[slot] = timer_id
                 attrs[0] = hash31(timer_id)
-                attrs[1] = a.get("start_to_fire_timeout_seconds", 0)
+                attrs[1] = _timeout(a, "start_to_fire_timeout_seconds")
 
             elif et in (EventType.TimerFired, EventType.TimerCanceled):
                 timer_id = a.get("timer_id", "")
@@ -306,10 +358,10 @@ def pack_workflow(
                 slot = children.get(init)
                 if slot is None:
                     raise PackError(f"child started for unknown initiated {init}")
-                run_id = a.get("run_id", "")
-                side.child_run_ids[slot] = run_id
+                child_run_id = a.get("run_id", "")
+                side.child_run_ids[slot] = child_run_id
                 attrs[0] = init
-                attrs[1] = hash31(run_id) if run_id else 0
+                attrs[1] = hash31(child_run_id) if child_run_id else 0
 
             elif et in (
                 EventType.StartChildWorkflowExecutionFailed,
@@ -369,7 +421,7 @@ def pack_workflow(
                 ev.event_id,
                 ev.version,
                 ev.task_id,
-                _ts_seconds(ev.timestamp),
+                rel_ts(ev.timestamp),
                 batch_first,
                 1 if i == len(batch) - 1 else 0,
                 slot,
@@ -400,14 +452,24 @@ def pack_histories(
     events[:, :, S.EV_TYPE] = -1  # padding sentinel
     lengths = np.zeros((bp,), dtype=np.int32)
     side: List[WorkflowSideTable] = []
+    first_ts = [
+        batches[0][0].timestamp
+        for _, _, batches in histories
+        if batches and batches[0]
+    ]
+    epoch_s = min(first_ts) // SECONDS if first_ts else 0
     for idx, (wf_id, run_id, batches) in enumerate(histories):
-        arr, st = pack_workflow(batches, caps, workflow_id=wf_id, run_id=run_id)
+        arr, st = pack_workflow(
+            batches, caps, workflow_id=wf_id, run_id=run_id, epoch_s=epoch_s
+        )
         n = arr.shape[0]
         events[idx, :n, :] = arr
         lengths[idx] = n
         side.append(st)
     for _ in range(bp - b):
         side.append(WorkflowSideTable())
-    return PackedHistories(events=events, lengths=lengths, side=side, caps=caps)
+    return PackedHistories(
+        events=events, lengths=lengths, side=side, caps=caps, epoch_s=epoch_s
+    )
 
 

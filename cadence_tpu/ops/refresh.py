@@ -233,6 +233,11 @@ def hydrate_tasks(
     r = refreshed
     if not isinstance(r.close_transfer, np.ndarray):
         r = refreshed_to_numpy(r)
+    epoch_s = packed.epoch_s
+
+    def vis_ns(rel: int) -> int:
+        # inverse of the packer's epoch rebasing (pack.py rel_ts)
+        return (rel + epoch_s - 1) * SECOND
     side = packed.side[b]
     transfer: List[T.TransferTask] = []
     timer: List[T.TimerTask] = []
@@ -243,7 +248,7 @@ def hydrate_tasks(
 
     timer.append(T.TimerTask(
         task_type=TimerTaskType.WorkflowTimeout,
-        visibility_timestamp=int(r.workflow_timeout_ts[b]) * SECOND,
+        visibility_timestamp=vis_ns(int(r.workflow_timeout_ts[b])),
     ))
     if r.decision_transfer[b] != -1:
         transfer.append(T.decision_transfer_task(
@@ -253,7 +258,7 @@ def hydrate_tasks(
             vis, sid, attempt = (int(x) for x in r.decision_timer[b])
             timer.append(T.TimerTask(
                 task_type=TimerTaskType.DecisionTimeout,
-                visibility_timestamp=vis * SECOND,
+                visibility_timestamp=vis_ns(vis),
                 timeout_type=int(TimeoutType.StartToClose),
                 event_id=sid,
                 schedule_attempt=attempt,
@@ -271,7 +276,7 @@ def hydrate_tasks(
         vis, tt, sid, attempt, ver = (int(x) for x in r.activity_timer[b])
         timer.append(T.TimerTask(
             task_type=TimerTaskType.ActivityTimeout,
-            visibility_timestamp=vis * SECOND,
+            visibility_timestamp=vis_ns(vis),
             timeout_type=tt,
             event_id=sid,
             schedule_attempt=attempt,
@@ -281,7 +286,7 @@ def hydrate_tasks(
         vis, sid, ver = (int(x) for x in r.user_timer[b])
         timer.append(T.TimerTask(
             task_type=TimerTaskType.UserTimer,
-            visibility_timestamp=vis * SECOND,
+            visibility_timestamp=vis_ns(vis),
             event_id=sid,
             version=ver,
         ))

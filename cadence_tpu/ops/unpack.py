@@ -39,6 +39,18 @@ from cadence_tpu.utils.hashing import hash31
 from . import schema as S
 from .pack import SECONDS, WorkflowSideTable
 
+# exec/table columns holding timestamps (relative-epoch encoded on device)
+_EXEC_TS_KEYS = {
+    "start_ts", "dec_scheduled_ts", "dec_started_ts",
+    "dec_original_scheduled_ts", "wf_expiration_ts",
+}
+
+
+def _abs_s(v: int, epoch_s: int) -> int:
+    """Inverse of the packer's rel_ts: 0 stays the unset sentinel."""
+    return v + epoch_s - 1 if v > 0 else v
+
+
 _EXEC_FIELDS = [
     ("state", S.X_STATE),
     ("close_status", S.X_CLOSE_STATUS),
@@ -68,10 +80,17 @@ _EXEC_FIELDS = [
 ]
 
 
-def state_row_to_snapshot(state: S.StateTensors, b: int) -> Dict[str, Any]:
+def state_row_to_snapshot(
+    state: S.StateTensors, b: int, epoch_s: int = 0
+) -> Dict[str, Any]:
     """Canonical snapshot of workflow ``b`` from kernel output."""
     ex = np.asarray(state.exec_info[b])
-    snap: Dict[str, Any] = {"exec": {k: int(ex[c]) for k, c in _EXEC_FIELDS}}
+    snap: Dict[str, Any] = {
+        "exec": {
+            k: (_abs_s(int(ex[c]), epoch_s) if k in _EXEC_TS_KEYS else int(ex[c]))
+            for k, c in _EXEC_FIELDS
+        }
+    }
 
     acts = {}
     for row in np.asarray(state.activities[b]):
@@ -79,9 +98,9 @@ def state_row_to_snapshot(state: S.StateTensors, b: int) -> Dict[str, Any]:
             acts[int(row[S.AC_SCHEDULE_ID])] = {
                 "version": int(row[S.AC_VERSION]),
                 "scheduled_event_batch_id": int(row[S.AC_SCHEDULED_BATCH_ID]),
-                "scheduled_ts": int(row[S.AC_SCHEDULED_TS]),
+                "scheduled_ts": _abs_s(int(row[S.AC_SCHEDULED_TS]), epoch_s),
                 "started_id": int(row[S.AC_STARTED_ID]),
-                "started_ts": int(row[S.AC_STARTED_TS]),
+                "started_ts": _abs_s(int(row[S.AC_STARTED_TS]), epoch_s),
                 "id_hash": int(row[S.AC_ID_HASH]),
                 "schedule_to_start": int(row[S.AC_SCH_TO_START]),
                 "schedule_to_close": int(row[S.AC_SCH_TO_CLOSE]),
@@ -91,8 +110,8 @@ def state_row_to_snapshot(state: S.StateTensors, b: int) -> Dict[str, Any]:
                 "cancel_request_id": int(row[S.AC_CANCEL_REQUEST_ID]),
                 "attempt": int(row[S.AC_ATTEMPT]),
                 "has_retry": int(row[S.AC_HAS_RETRY]),
-                "expiration_ts": int(row[S.AC_EXPIRATION_TS]),
-                "last_hb_ts": int(row[S.AC_LAST_HB_TS]),
+                "expiration_ts": _abs_s(int(row[S.AC_EXPIRATION_TS]), epoch_s),
+                "last_hb_ts": _abs_s(int(row[S.AC_LAST_HB_TS]), epoch_s),
             }
     snap["activities"] = acts
 
@@ -102,7 +121,7 @@ def state_row_to_snapshot(state: S.StateTensors, b: int) -> Dict[str, Any]:
             timers[int(row[S.TI_STARTED_ID])] = {
                 "version": int(row[S.TI_VERSION]),
                 "id_hash": int(row[S.TI_ID_HASH]),
-                "expiry_ts": int(row[S.TI_EXPIRY_TS]),
+                "expiry_ts": _abs_s(int(row[S.TI_EXPIRY_TS]), epoch_s),
             }
     snap["timers"] = timers
 
@@ -240,8 +259,13 @@ def mutable_state_to_snapshot(ms: MutableState) -> Dict[str, Any]:
 def state_row_to_mutable_state(
     state: S.StateTensors, b: int, side: WorkflowSideTable,
     domain_id: str = "",
+    epoch_s: int = 0,
 ) -> MutableState:
     """Rehydrate a full MutableState from kernel output + side table."""
+
+    def ns(v: int) -> int:
+        return _abs_s(int(v), epoch_s) * SECONDS
+
     ex = np.asarray(state.exec_info[b])
     ms = MutableState(domain_id=domain_id, current_version=int(ex[S.X_CUR_VERSION]))
     ei = ms.execution_info
@@ -262,7 +286,7 @@ def state_row_to_mutable_state(
     ei.last_first_event_id = int(ex[S.X_LAST_FIRST_EVENT_ID])
     ei.last_event_task_id = int(ex[S.X_LAST_EVENT_TASK_ID])
     ei.last_processed_event = int(ex[S.X_LAST_PROCESSED_EVENT])
-    ei.start_timestamp = int(ex[S.X_START_TS]) * SECONDS
+    ei.start_timestamp = ns(ex[S.X_START_TS])
     ei.workflow_timeout = int(ex[S.X_WORKFLOW_TIMEOUT])
     ei.decision_timeout_value = int(ex[S.X_DECISION_TIMEOUT_VALUE])
     ei.decision_version = int(ex[S.X_DEC_VERSION])
@@ -270,18 +294,16 @@ def state_row_to_mutable_state(
     ei.decision_started_id = int(ex[S.X_DEC_STARTED_ID])
     ei.decision_timeout = int(ex[S.X_DEC_TIMEOUT])
     ei.decision_attempt = int(ex[S.X_DEC_ATTEMPT])
-    ei.decision_scheduled_timestamp = int(ex[S.X_DEC_SCHEDULED_TS]) * SECONDS
-    ei.decision_started_timestamp = int(ex[S.X_DEC_STARTED_TS]) * SECONDS
-    ei.decision_original_scheduled_timestamp = (
-        int(ex[S.X_DEC_ORIGINAL_SCHEDULED_TS]) * SECONDS
-    )
+    ei.decision_scheduled_timestamp = ns(ex[S.X_DEC_SCHEDULED_TS])
+    ei.decision_started_timestamp = ns(ex[S.X_DEC_STARTED_TS])
+    ei.decision_original_scheduled_timestamp = ns(ex[S.X_DEC_ORIGINAL_SCHEDULED_TS])
     ei.cancel_requested = bool(ex[S.X_CANCEL_REQUESTED])
     ei.signal_count = int(ex[S.X_SIGNAL_COUNT])
     ei.attempt = int(ex[S.X_ATTEMPT])
     ei.has_retry_policy = bool(ex[S.X_HAS_RETRY_POLICY])
     ei.completion_event_batch_id = int(ex[S.X_COMPLETION_EVENT_BATCH_ID])
     ei.initiated_id = int(ex[S.X_PARENT_INITIATED_ID])
-    ei.expiration_time = int(ex[S.X_WF_EXPIRATION_TS]) * SECONDS
+    ei.expiration_time = ns(ex[S.X_WF_EXPIRATION_TS])
 
     for slot, row in enumerate(np.asarray(state.activities[b])):
         if not row[S.AC_OCC]:
@@ -291,9 +313,9 @@ def state_row_to_mutable_state(
             version=int(row[S.AC_VERSION]),
             schedule_id=int(row[S.AC_SCHEDULE_ID]),
             scheduled_event_batch_id=int(row[S.AC_SCHEDULED_BATCH_ID]),
-            scheduled_time=int(row[S.AC_SCHEDULED_TS]) * SECONDS,
+            scheduled_time=ns(row[S.AC_SCHEDULED_TS]),
             started_id=int(row[S.AC_STARTED_ID]),
-            started_time=int(row[S.AC_STARTED_TS]) * SECONDS,
+            started_time=ns(row[S.AC_STARTED_TS]),
             activity_id=activity_id,
             schedule_to_start_timeout=int(row[S.AC_SCH_TO_START]),
             schedule_to_close_timeout=int(row[S.AC_SCH_TO_CLOSE]),
@@ -303,8 +325,8 @@ def state_row_to_mutable_state(
             cancel_request_id=int(row[S.AC_CANCEL_REQUEST_ID]),
             attempt=int(row[S.AC_ATTEMPT]),
             has_retry_policy=bool(row[S.AC_HAS_RETRY]),
-            expiration_time=int(row[S.AC_EXPIRATION_TS]) * SECONDS,
-            last_heartbeat_updated_time=int(row[S.AC_LAST_HB_TS]) * SECONDS,
+            expiration_time=ns(row[S.AC_EXPIRATION_TS]),
+            last_heartbeat_updated_time=ns(row[S.AC_LAST_HB_TS]),
             task_list=side.activity_task_lists.get(slot, ""),
         )
         ms.pending_activities[ai.schedule_id] = ai
@@ -318,7 +340,7 @@ def state_row_to_mutable_state(
             version=int(row[S.TI_VERSION]),
             timer_id=timer_id,
             started_id=int(row[S.TI_STARTED_ID]),
-            expiry_time=int(row[S.TI_EXPIRY_TS]) * SECONDS,
+            expiry_time=ns(row[S.TI_EXPIRY_TS]),
         )
         ms.pending_timers[timer_id] = ti
         ms.timer_by_started_id[ti.started_id] = timer_id

@@ -1,0 +1,1 @@
+"""Test fixtures: event-graph fuzzer, fake membership, scripted pollers."""

@@ -1,0 +1,79 @@
+"""Differential fuzzing: random valid histories, kernel vs oracle.
+
+The event-graph fuzzer (cadence_tpu/testing/event_generator.py) plays the
+role of the reference's model-based generator in its NDC tests
+(host/ndc/nDC_integration_test.go:114-126): every generated walk is a
+legal history, and the device kernel must agree with the host oracle on
+all of them.
+"""
+
+import pytest
+
+from cadence_tpu.core.task_refresher import refresh_tasks
+from cadence_tpu.ops.pack import pack_histories
+from cadence_tpu.ops.refresh import (
+    hydrate_tasks,
+    refresh_tasks_device_jit,
+    refreshed_to_numpy,
+)
+from cadence_tpu.ops.replay import replay_packed
+from cadence_tpu.ops.schema import Capacities
+from cadence_tpu.ops.unpack import mutable_state_to_snapshot, state_row_to_snapshot
+from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+from test_replay_differential import oracle_replay
+
+CAPS = Capacities(max_events=256)
+
+
+def test_fuzz_parity_bulk():
+    """One packed batch of 48 random histories — state + task parity."""
+    n = 48
+    histories = []
+    for seed in range(n):
+        fuzzer = HistoryFuzzer(seed=seed, caps=CAPS)
+        batches = fuzzer.generate(
+            target_events=30 + (seed % 5) * 30,
+            close=seed % 3 != 0,  # a third stay open
+        )
+        histories.append((f"wf-{seed}", f"run-{seed}", batches))
+
+    packed = pack_histories(histories, caps=CAPS)
+    final = replay_packed(packed)
+    refreshed = refreshed_to_numpy(refresh_tasks_device_jit(final))
+
+    for i, (_, _, batches) in enumerate(histories):
+        ms = oracle_replay(batches, workflow_id=f"wf-{i}", run_id=f"run-{i}")
+        oracle_snap = mutable_state_to_snapshot(ms)
+        kernel_snap = state_row_to_snapshot(final, i, packed.epoch_s)
+        assert kernel_snap == oracle_snap, f"seed {i} state diverged"
+
+        dev_transfer, dev_timer = hydrate_tasks(refreshed, i, packed, domain_id="dom")
+        ms.execution_info.domain_id = "dom"
+        host_transfer, host_timer = refresh_tasks(ms)
+        assert [
+            (t.task_type, t.schedule_id, t.initiated_id) for t in dev_transfer
+        ] == [
+            (t.task_type, t.schedule_id, t.initiated_id) for t in host_transfer
+        ], f"seed {i} transfer tasks diverged"
+        assert [
+            (t.task_type, t.visibility_timestamp, t.timeout_type, t.event_id,
+             t.schedule_attempt)
+            for t in dev_timer
+        ] == [
+            (t.task_type, t.visibility_timestamp, t.timeout_type, t.event_id,
+             t.schedule_attempt)
+            for t in host_timer
+        ], f"seed {i} timer tasks diverged"
+
+
+def test_fuzzer_reproducible():
+    a = HistoryFuzzer(seed=7, caps=CAPS).generate(target_events=50)
+    b = HistoryFuzzer(seed=7, caps=CAPS).generate(target_events=50)
+    assert a == b
+
+
+def test_fuzzer_event_ids_contiguous():
+    batches = HistoryFuzzer(seed=3, caps=CAPS).generate(target_events=60)
+    flat = [e for batch in batches for e in batch]
+    assert [e.event_id for e in flat] == list(range(1, len(flat) + 1))

@@ -40,7 +40,7 @@ def assert_parity(batches_per_workflow):
     packed = pack_histories(histories)
     final = replay_packed(packed)
     for i, (_, _, batches) in enumerate(histories):
-        kernel_snap = state_row_to_snapshot(final, i)
+        kernel_snap = state_row_to_snapshot(final, i, packed.epoch_s)
         oracle_snap = mutable_state_to_snapshot(
             oracle_replay(batches, workflow_id=f"wf-{i}", run_id=f"run-{i}")
         )
@@ -254,10 +254,10 @@ class TestKernelOracleParity:
         packed = pack_histories(histories, pad_batch_to=8)
         assert packed.batch == 8
         final = replay_packed(packed)
-        snap = state_row_to_snapshot(final, 0)
+        snap = state_row_to_snapshot(final, 0, packed.epoch_s)
         assert snap == mutable_state_to_snapshot(oracle_replay(echo_batches()))
         # padded rows stay pristine
-        pad = state_row_to_snapshot(final, 7)
+        pad = state_row_to_snapshot(final, 7, packed.epoch_s)
         assert pad["activities"] == {} and pad["version_history"] == []
         assert pad["exec"]["state"] == 0
 
