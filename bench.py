@@ -1,0 +1,119 @@
+"""North-star benchmark: batched deep-history replay throughput.
+
+Measures histories rebuilt per second at ~1k-event depth — the metric in
+BASELINE.json ("histories replayed/sec/chip @1k-event depth"). One
+device step = replay scan + vectorized task refresh, i.e. the full
+rebuild semantics of the reference's nDCStateRebuilder.rebuild
+(/root/reference/service/history/nDCStateRebuilder.go:92-160: replay all
+batches, then taskRefresher.refreshTasks).
+
+Baseline: the reference's per-workflow sequential loop. The Go toolchain
+is not present in this image, so the recorded ``vs_baseline`` is the
+speedup over this repo's host oracle (cadence_tpu/core/state_builder.py),
+which implements the identical per-event transition semantics the Go
+loop does (differential-tested), measured on the same histories on this
+host's CPU. Go is typically ~10-50x faster than CPython on this kind of
+branchy struct code, so divide by that factor for a Go-equivalent
+estimate.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if "--cpu" in sys.argv:
+    # the axon plugin bootstrap rewrites JAX_PLATFORMS; pin via config
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    from cadence_tpu.core.mutable_state import MutableState
+    from cadence_tpu.core.state_builder import StateBuilder
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.pack import PackedHistories, pack_histories
+    from cadence_tpu.ops.refresh import refresh_tasks_device
+    from cadence_tpu.ops.replay import replay_scan
+    from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+    on_cpu = jax.default_backend() == "cpu"
+    depth = 1000
+    n_unique = 32
+    batch = 512 if on_cpu else 4096
+    iters = 2 if on_cpu else 8
+
+    caps = S.Capacities(max_events=1024)
+    fuzzer = HistoryFuzzer(seed=42, caps=caps)
+    histories = [
+        (f"wf-{i}", f"run-{i}", fuzzer.generate(target_events=depth, close_prob=0.0))
+        for i in range(n_unique)
+    ]
+    packed = pack_histories(histories, caps=caps)
+
+    # tile the unique histories up to the full batch
+    reps = (batch + n_unique - 1) // n_unique
+    events = np.tile(packed.events, (reps, 1, 1))[:batch]
+    lengths = np.tile(packed.lengths, reps)[:batch]
+    mean_depth = float(lengths.mean())
+
+    events_tm = jnp.asarray(
+        np.ascontiguousarray(np.transpose(events, (1, 0, 2)))
+    )
+
+    def step(state, ev_tm):
+        final = replay_scan(state, ev_tm)
+        return final, refresh_tasks_device(final)
+
+    step_jit = jax.jit(step)
+
+    # device-resident zero state, reused every iteration (step_jit does
+    # not donate, so the buffer survives)
+    state0 = jax.device_put(
+        jax.tree_util.tree_map(jnp.asarray, S.empty_state(batch, caps))
+    )
+    state0 = jax.block_until_ready(state0)
+
+    # warmup / compile
+    out = step_jit(state0, events_tm)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_jit(state0, events_tm)
+    jax.block_until_ready(out)
+    device_s = (time.perf_counter() - t0) / iters
+    device_rate = batch / device_s
+
+    # host-oracle baseline: same semantics, per-workflow sequential loop
+    n_oracle = 4
+    t0 = time.perf_counter()
+    for i in range(n_oracle):
+        wf_id, run_id, batches = histories[i % n_unique]
+        ms = MutableState(domain_id="dom")
+        sb = StateBuilder(ms, id_generator=lambda: "fixed")
+        sb.apply_batches("dom", "req", wf_id, run_id, batches)
+    oracle_s = (time.perf_counter() - t0) / n_oracle
+    oracle_rate = 1.0 / oracle_s
+
+    print(
+        json.dumps(
+            {
+                "metric": f"histories_replayed_per_sec_at_{int(round(mean_depth))}ev_depth",
+                "value": round(device_rate, 2),
+                "unit": "histories/s",
+                "vs_baseline": round(device_rate / oracle_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -172,7 +172,9 @@ def pack_workflow(
     def rel_ts(ns: int) -> int:
         s = ns // SECONDS - epoch_s + 1
         if not (1 <= s < MAX_REL_TS):
-            raise PackError(
+            # a representability limit, not malformed input: the host
+            # oracle replays such histories fine, so route them there
+            raise PackOverflowError(
                 f"timestamp {ns} out of packable window (epoch {epoch_s})"
             )
         return int(s)

@@ -1,0 +1,32 @@
+"""Device-mesh parallelism for batched history replay.
+
+The reference scales horizontally by hashing workflowID -> shard and
+spreading shards over hosts via a ringpop consistent-hash ring
+(/root/reference/service/history/shardController.go:96,
+/root/reference/common/util.go:249-251). Here the same dimension is a
+tensor axis: each shard's replay requests are rows of the [B, T] event
+tensor, and shards map onto TPU devices through a `jax.sharding.Mesh`
+("shard" axis = Cadence's horizontal sharding; "seq" axis = the time-
+pipelined long-history path, SURVEY.md §2.8).
+
+ICI collectives (all_gather / psum / ppermute) replace the reference's
+cross-host RPC fan-out for the NDC replication-storm snapshot exchange
+(BASELINE config 5).
+"""
+
+from cadence_tpu.parallel.mesh import make_mesh, shard_spec
+from cadence_tpu.parallel.replay_sharded import (
+    ndc_snapshot_exchange,
+    replay_packed_sharded,
+    replay_sharded_fn,
+)
+from cadence_tpu.parallel.pipeline import replay_pipelined
+
+__all__ = [
+    "make_mesh",
+    "shard_spec",
+    "replay_sharded_fn",
+    "replay_packed_sharded",
+    "ndc_snapshot_exchange",
+    "replay_pipelined",
+]

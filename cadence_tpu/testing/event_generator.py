@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Set
 from cadence_tpu.core import history_factory as F
 from cadence_tpu.core.enums import ParentClosePolicy, TimeoutType
 from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.ids import EMPTY_EVENT_ID
 from cadence_tpu.core.mutable_state import SECOND
 from cadence_tpu.ops.schema import Capacities
 
@@ -41,6 +42,7 @@ class HistoryFuzzer:
         start_time: int = 1_700_000_000 * SECOND,
         version: int = 10,
         close: bool = True,
+        close_prob: float = 0.1,
     ) -> List[List[HistoryEvent]]:
         """One random valid history as a list of transaction batches."""
         rng = self.rng
@@ -121,7 +123,7 @@ class HistoryFuzzer:
                     continue
                 # async environment events between decisions
                 self._async_event(
-                    locals_bundle := _Bundle(
+                    _Bundle(
                         rng=rng, v=v, t=t, next_id=next_id, emit=emit,
                         acts_scheduled=acts_scheduled, acts_started=acts_started,
                         act_names_live=act_names_live, timers=timers,
@@ -248,7 +250,7 @@ class HistoryFuzzer:
                         decision_task_completed_event_id=completed_id))
 
             # maybe close in this same batch
-            if close and (eid >= target_events or rng.random() < 0.1):
+            if close and (eid >= target_events or rng.random() < close_prob):
                 closer = rng.random()
                 if closer < 0.5:
                     batch.append(F.workflow_execution_completed(
@@ -310,7 +312,8 @@ class HistoryFuzzer:
             sid = rng.choice(unstarted)
             b.emit([F.activity_task_timed_out(
                 b.next_id(), b.v, b.t, scheduled_event_id=sid,
-                started_event_id=-23, timeout_type=TimeoutType.ScheduleToStart)])
+                started_event_id=EMPTY_EVENT_ID,
+                timeout_type=TimeoutType.ScheduleToStart)])
             b.act_names_live.discard(b.acts_scheduled.pop(sid))
         elif choice in ("act_complete", "act_fail", "act_timeout"):
             sid = rng.choice(started)

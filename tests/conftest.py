@@ -17,11 +17,16 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The axon plugin bootstrap rewrites jax_platforms to "axon,cpu" even when
+# JAX_PLATFORMS=cpu is set in the environment; pin it back before any
+# backend initializes so the 8-device flag takes effect.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def jax_devices():
-    import jax
-
     return jax.devices()
