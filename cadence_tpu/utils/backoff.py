@@ -1,0 +1,147 @@
+"""Exponential retry with jitter + retry-policy interval math.
+
+Two distinct things share the name in the reference and here too:
+
+  * ``RetryPolicy`` / ``next_backoff_interval`` — the *workflow/activity*
+    retry semantics (/root/reference/service/history/retry.go): given a
+    RetryPolicy and attempt count, when does the next attempt start, and
+    does the error/expiration terminate retrying.
+  * ``Retry`` / ``ExponentialRetryPolicy`` — host-side operation retries
+    (/root/reference/common/backoff/retry.go): persistence calls, RPC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Sequence, TypeVar
+
+from .clock import SECOND
+
+NO_INTERVAL = -1  # stop retrying
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Workflow/activity retry policy (reference idl RetryPolicy;
+    validation mirrors common/util.go ValidateRetryPolicy)."""
+
+    initial_interval_seconds: int = 1
+    backoff_coefficient: float = 2.0
+    maximum_interval_seconds: int = 0      # 0 = uncapped
+    maximum_attempts: int = 0              # 0 = unlimited
+    expiration_seconds: int = 0            # 0 = no expiry
+    non_retriable_errors: Sequence[str] = ()
+
+    def validate(self) -> None:
+        if self.initial_interval_seconds <= 0:
+            raise ValueError("InitialIntervalInSeconds must be positive")
+        if self.backoff_coefficient < 1:
+            raise ValueError("BackoffCoefficient cannot be less than 1")
+        if self.maximum_interval_seconds < 0:
+            raise ValueError("MaximumIntervalInSeconds cannot be negative")
+        if self.maximum_interval_seconds and (
+            self.maximum_interval_seconds < self.initial_interval_seconds
+        ):
+            raise ValueError(
+                "MaximumIntervalInSeconds cannot be less than "
+                "InitialIntervalInSeconds"
+            )
+        if self.maximum_attempts < 0:
+            raise ValueError("MaximumAttempts cannot be negative")
+        if self.expiration_seconds < 0:
+            raise ValueError("ExpirationIntervalInSeconds cannot be negative")
+        if self.maximum_attempts == 0 and self.expiration_seconds == 0:
+            raise ValueError(
+                "MaximumAttempts and ExpirationIntervalInSeconds cannot "
+                "both be zero"
+            )
+
+
+def next_backoff_interval_seconds(
+    policy: RetryPolicy,
+    attempt: int,
+    expiration_ts_ns: int,
+    now_ns: int,
+    error_reason: str = "",
+) -> int:
+    """Seconds until the next attempt, or NO_INTERVAL to stop.
+
+    ``attempt`` is 0-based (the attempt that just failed). Mirrors
+    getBackoffInterval (/root/reference/service/history/retry.go)."""
+    if policy.maximum_attempts == 0 and policy.expiration_seconds == 0:
+        return NO_INTERVAL
+    if policy.maximum_attempts > 0 and attempt >= policy.maximum_attempts - 1:
+        return NO_INTERVAL
+    if error_reason and error_reason in tuple(policy.non_retriable_errors):
+        return NO_INTERVAL
+    interval = policy.initial_interval_seconds * (
+        policy.backoff_coefficient ** attempt
+    )
+    if policy.maximum_interval_seconds:
+        interval = min(interval, policy.maximum_interval_seconds)
+    interval = int(interval)
+    if interval <= 0:
+        return NO_INTERVAL
+    if expiration_ts_ns and now_ns + interval * SECOND > expiration_ts_ns:
+        return NO_INTERVAL
+    return interval
+
+
+@dataclasses.dataclass
+class ExponentialRetryPolicy:
+    """Host-operation retry schedule (common/backoff/retrypolicy.go)."""
+
+    initial_interval_s: float = 0.05
+    backoff_coefficient: float = 2.0
+    maximum_interval_s: float = 10.0
+    expiration_interval_s: float = 60.0    # 0 = none
+    maximum_attempts: int = 0              # 0 = unlimited
+    jitter: float = 0.2
+
+    def compute_next_delay(self, attempt: int, elapsed_s: float) -> float:
+        """Delay in seconds before attempt ``attempt`` (1-based), or < 0."""
+        if self.maximum_attempts and attempt >= self.maximum_attempts:
+            return -1.0
+        if self.expiration_interval_s and elapsed_s >= self.expiration_interval_s:
+            return -1.0
+        d = self.initial_interval_s * (self.backoff_coefficient ** (attempt - 1))
+        d = min(d, self.maximum_interval_s)
+        if self.jitter:
+            d *= 1 + random.uniform(-self.jitter, self.jitter)
+        return d
+
+
+T = TypeVar("T")
+
+
+class NonRetriableError(Exception):
+    """Wrap an error to break out of Retry immediately."""
+
+
+def retry(
+    op: Callable[[], T],
+    policy: Optional[ExponentialRetryPolicy] = None,
+    is_retriable: Callable[[Exception], bool] = lambda e: True,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run ``op`` with exponential backoff until success/exhaustion."""
+    policy = policy or ExponentialRetryPolicy()
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return op()
+        except NonRetriableError as e:
+            raise (e.__cause__ or e)
+        except Exception as e:  # noqa: BLE001 — predicate decides
+            if not is_retriable(e):
+                raise
+            delay = policy.compute_next_delay(
+                attempt, time.monotonic() - start
+            )
+            if delay < 0:
+                raise
+            sleep(delay)

@@ -1,0 +1,64 @@
+"""Structured logging with typed tag vocabulary.
+
+The reference wraps zap with ~1k LoC of typed tags
+(/root/reference/common/log/tag/). Here: stdlib logging with a tag dict
+carried by child loggers, rendered as key=value pairs — the same
+grep-able discipline without the ceremony."""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any, Dict, Optional
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+_configured = False
+
+
+def _ensure_configured() -> None:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root = logging.getLogger("cadence_tpu")
+        if not root.handlers:
+            root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _configured = True
+
+
+class Logger:
+    def __init__(self, name: str = "cadence_tpu", tags: Optional[Dict[str, Any]] = None):
+        _ensure_configured()
+        self._log = logging.getLogger(name)
+        self._tags = dict(tags or {})
+
+    def with_tags(self, **tags: Any) -> "Logger":
+        merged = dict(self._tags)
+        merged.update(tags)
+        return Logger(self._log.name, merged)
+
+    def _fmt(self, msg: str, tags: Dict[str, Any]) -> str:
+        merged = dict(self._tags)
+        merged.update(tags)
+        if merged:
+            kv = " ".join(f"{k}={v}" for k, v in merged.items())
+            return f"{msg} | {kv}"
+        return msg
+
+    def debug(self, msg: str, **tags: Any) -> None:
+        self._log.debug(self._fmt(msg, tags))
+
+    def info(self, msg: str, **tags: Any) -> None:
+        self._log.info(self._fmt(msg, tags))
+
+    def warn(self, msg: str, **tags: Any) -> None:
+        self._log.warning(self._fmt(msg, tags))
+
+    def error(self, msg: str, **tags: Any) -> None:
+        self._log.error(self._fmt(msg, tags))
+
+
+def get_logger(name: str = "cadence_tpu", **tags: Any) -> Logger:
+    return Logger(name, tags)

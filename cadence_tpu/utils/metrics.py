@@ -1,0 +1,129 @@
+"""Lightweight metrics registry: counters, gauges, timers, scopes.
+
+The shape of the reference's tally-based metrics layer
+(/root/reference/common/metrics/: Scope with Counter/Timer/Gauge, tagged
+sub-scopes per service/operation/domain) without an external sink:
+in-process aggregation with an introspection API, plus an optional
+snapshot dump. Every runtime layer takes a Scope so per-API and
+per-store latencies are observable in tests and benchmarks."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional, Tuple
+
+TagTuple = Tuple[Tuple[str, str], ...]
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> TagTuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Registry:
+    """Process-wide metric store; thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, TagTuple], int] = defaultdict(int)
+        self._gauges: Dict[Tuple[str, TagTuple], float] = {}
+        # timers: (count, total_s, max_s)
+        self._timers: Dict[Tuple[str, TagTuple], Tuple[int, float, float]] = (
+            defaultdict(lambda: (0, 0.0, 0.0))
+        )
+
+    def inc(self, name: str, tags: TagTuple, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[(name, tags)] += delta
+
+    def gauge(self, name: str, tags: TagTuple, value: float) -> None:
+        with self._lock:
+            self._gauges[(name, tags)] = value
+
+    def record(self, name: str, tags: TagTuple, seconds: float) -> None:
+        with self._lock:
+            n, total, mx = self._timers[(name, tags)]
+            self._timers[(name, tags)] = (n + 1, total + seconds, max(mx, seconds))
+
+    # -- introspection -------------------------------------------------
+
+    def counter_value(self, name: str, tags: Optional[Dict[str, str]] = None) -> int:
+        with self._lock:
+            if tags is not None:
+                return self._counters.get((name, _tags_key(tags)), 0)
+            return sum(v for (n, _), v in self._counters.items() if n == name)
+
+    def timer_stats(
+        self, name: str, tags: Optional[Dict[str, str]] = None
+    ) -> Tuple[int, float, float]:
+        with self._lock:
+            if tags is not None:
+                return self._timers.get((name, _tags_key(tags)), (0, 0.0, 0.0))
+            agg = (0, 0.0, 0.0)
+            for (n, _), (c, t, m) in self._timers.items():
+                if n == name:
+                    agg = (agg[0] + c, agg[1] + t, max(agg[2], m))
+            return agg
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                "counters": {
+                    f"{n}{dict(t)}": v for (n, t), v in self._counters.items()
+                },
+                "gauges": {
+                    f"{n}{dict(t)}": v for (n, t), v in self._gauges.items()
+                },
+                "timers": {
+                    f"{n}{dict(t)}": {"count": c, "total_s": ts, "max_s": m}
+                    for (n, t), (c, ts, m) in self._timers.items()
+                },
+            }
+
+
+class Timer:
+    def __init__(self, registry: Registry, name: str, tags: TagTuple) -> None:
+        self._registry, self._name, self._tags = registry, name, tags
+        self._start = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._registry.record(
+            self._name, self._tags, time.perf_counter() - self._start
+        )
+
+
+class Scope:
+    """A tag context; sub-scopes add tags (tally-style)."""
+
+    def __init__(
+        self, registry: Optional[Registry] = None,
+        tags: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.registry = registry or Registry()
+        self._tags = dict(tags or {})
+        self._key = _tags_key(self._tags)
+
+    def tagged(self, **tags: str) -> "Scope":
+        merged = dict(self._tags)
+        merged.update(tags)
+        return Scope(self.registry, merged)
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        self.registry.inc(name, self._key, delta)
+
+    def gauge(self, name: str, value: float) -> None:
+        self.registry.gauge(name, self._key, value)
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.registry, name, self._key)
+
+    def record(self, name: str, seconds: float) -> None:
+        self.registry.record(name, self._key, seconds)
+
+
+NOOP = Scope()  # shared default; fine because Registry is thread-safe

@@ -1,0 +1,66 @@
+"""Token-bucket rate limiting (reference: common/quotas/, common/tokenbucket/).
+
+A multi-policy limiter: a global RPS cap plus per-domain caps, the shape
+the frontend and persistence layers apply
+(/root/reference/common/quotas/ratelimiter.go)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class TokenBucket:
+    def __init__(
+        self,
+        rps: float,
+        burst: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.rps = float(rps)
+        self.burst = burst if burst is not None else max(1, int(rps))
+        self._tokens = float(self.burst)
+        self._last = clock()
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def allow(self, n: int = 1) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rps
+            )
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class MultiStageRateLimiter:
+    """Global + per-domain token buckets; both must admit the request."""
+
+    def __init__(
+        self,
+        global_rps: float,
+        domain_rps: Callable[[str], float],
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._global = TokenBucket(global_rps, clock=clock)
+        self._domain_rps = domain_rps
+        self._domains: Dict[str, TokenBucket] = {}
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def allow(self, domain: str = "") -> bool:
+        if not self._global.allow():
+            return False
+        if not domain:
+            return True
+        with self._lock:
+            bucket = self._domains.get(domain)
+            if bucket is None:
+                bucket = TokenBucket(self._domain_rps(domain), clock=self._clock)
+                self._domains[domain] = bucket
+        return bucket.allow()

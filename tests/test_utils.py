@@ -1,0 +1,154 @@
+"""Utils layer: clock, backoff, dynamicconfig, metrics, quotas."""
+
+import threading
+
+import pytest
+
+from cadence_tpu.utils.backoff import (
+    NO_INTERVAL,
+    ExponentialRetryPolicy,
+    RetryPolicy,
+    next_backoff_interval_seconds,
+    retry,
+)
+from cadence_tpu.utils.clock import SECOND, FakeTimeSource
+from cadence_tpu.utils.dynamicconfig import (
+    Collection,
+    FileBasedClient,
+    InMemoryClient,
+)
+from cadence_tpu.utils.metrics import Scope
+from cadence_tpu.utils.quotas import MultiStageRateLimiter, TokenBucket
+
+
+def test_fake_clock_advance_wakes_sleeper():
+    ts = FakeTimeSource(start_ns=0)
+    woke = threading.Event()
+
+    def sleeper():
+        ts.sleep(5 * SECOND)
+        woke.set()
+
+    t = threading.Thread(target=sleeper)
+    t.start()
+    assert not woke.wait(0.05)
+    ts.advance(5 * SECOND)
+    assert woke.wait(2.0)
+    t.join()
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(initial_interval_seconds=0).validate()
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_coefficient=0.5).validate()
+    with pytest.raises(ValueError):
+        RetryPolicy(maximum_attempts=0, expiration_seconds=0).validate()
+    RetryPolicy(maximum_attempts=3).validate()
+
+
+def test_next_backoff_interval():
+    p = RetryPolicy(
+        initial_interval_seconds=1, backoff_coefficient=2.0,
+        maximum_interval_seconds=10, maximum_attempts=5,
+    )
+    assert next_backoff_interval_seconds(p, 0, 0, 0) == 1
+    assert next_backoff_interval_seconds(p, 1, 0, 0) == 2
+    assert next_backoff_interval_seconds(p, 2, 0, 0) == 4
+    assert next_backoff_interval_seconds(p, 3, 0, 0) == 8
+    # attempt 4 is the 5th attempt -> exhausted
+    assert next_backoff_interval_seconds(p, 4, 0, 0) == NO_INTERVAL
+    # expiration cuts retries short
+    assert (
+        next_backoff_interval_seconds(p, 0, SECOND // 2, 0) == NO_INTERVAL
+    )
+    # non-retriable reason
+    p2 = RetryPolicy(maximum_attempts=5, non_retriable_errors=("bad",))
+    assert next_backoff_interval_seconds(p2, 0, 0, 0, "bad") == NO_INTERVAL
+
+
+def test_retry_succeeds_after_failures():
+    calls = {"n": 0}
+
+    def op():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert (
+        retry(
+            op,
+            ExponentialRetryPolicy(initial_interval_s=0.001, jitter=0),
+            sleep=lambda s: None,
+        )
+        == "ok"
+    )
+    assert calls["n"] == 3
+
+
+def test_retry_respects_predicate():
+    def op():
+        raise KeyError("fatal")
+
+    with pytest.raises(KeyError):
+        retry(op, is_retriable=lambda e: not isinstance(e, KeyError))
+
+
+def test_dynamicconfig_filter_precedence():
+    client = InMemoryClient()
+    client.set_value("k", 1)
+    client.set_value("k", 2, {"domainName": "d1"})
+    client.set_value("k", 3, {"domainName": "d1", "taskListName": "tl"})
+    col = Collection(client)
+    get = col.int_property("k", 0)
+    assert get() == 1
+    assert get(domainName="d1") == 2
+    assert get(domainName="d1", taskListName="tl") == 3
+    assert get(domainName="other") == 1
+    assert col.int_property("missing", 42)() == 42
+
+
+def test_dynamicconfig_file_client(tmp_path):
+    p = tmp_path / "dc.json"
+    p.write_text('{"x": [{"value": 7}]}')
+    client = FileBasedClient(str(p), poll_interval_s=0)
+    col = Collection(client)
+    assert col.int_property("x", 0)() == 7
+    assert col.duration_property("y", 5)() == 5
+
+
+def test_metrics_scope():
+    scope = Scope()
+    s = scope.tagged(service="history", operation="Start")
+    s.inc("requests")
+    s.inc("requests")
+    with s.timer("latency"):
+        pass
+    assert scope.registry.counter_value("requests") == 2
+    assert (
+        scope.registry.counter_value(
+            "requests", {"service": "history", "operation": "Start"}
+        )
+        == 2
+    )
+    count, total, mx = scope.registry.timer_stats("latency")
+    assert count == 1 and total >= 0
+
+
+def test_token_bucket():
+    t = [0.0]
+    tb = TokenBucket(10, burst=2, clock=lambda: t[0])
+    assert tb.allow() and tb.allow()
+    assert not tb.allow()
+    t[0] += 0.1  # refills one token
+    assert tb.allow()
+    assert not tb.allow()
+
+
+def test_multistage_limiter():
+    t = [0.0]
+    lim = MultiStageRateLimiter(100, lambda d: 1.0, clock=lambda: t[0])
+    assert lim.allow("d1")
+    assert not lim.allow("d1")  # domain bucket exhausted
+    assert lim.allow("d2")
