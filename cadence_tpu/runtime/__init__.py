@@ -1,0 +1,17 @@
+"""Host runtime: the control plane around the TPU replay data path.
+
+Layers (reference: SURVEY.md §1-2 of this repo):
+
+  persistence/  five-manager storage contract (shard / execution /
+                history-tree / task / metadata / visibility) with
+                in-memory and SQLite backends
+  shard/        shard context + controller (range-id fencing, task-id
+                sequencing, ack levels)
+  engine/       history engine: workflow mutations, decision pipeline,
+                workflow execution context, caches
+  queues/       transfer + timer queue processors
+  matching/     task-list dispatch (sync match + backlog)
+  frontend/     public API surface
+  membership/   host ring (static resolver for onebox; pluggable)
+  replication/  cross-cluster NDC replication runtime
+"""

@@ -1,0 +1,44 @@
+"""JSON serialization for queue-task records (shared by durable backends)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict
+
+from cadence_tpu.core.enums import TimerTaskType, TransferTaskType
+from cadence_tpu.core.tasks import ReplicationTask, TimerTask, TransferTask
+
+
+def transfer_to_json(t: TransferTask) -> str:
+    return json.dumps(dataclasses.asdict(t))
+
+
+def transfer_from_json(s: str) -> TransferTask:
+    d = json.loads(s)
+    d["task_type"] = TransferTaskType(d["task_type"])
+    return TransferTask(**d)
+
+
+def timer_to_json(t: TimerTask) -> str:
+    return json.dumps(dataclasses.asdict(t))
+
+
+def timer_from_json(s: str) -> TimerTask:
+    d = json.loads(s)
+    d["task_type"] = TimerTaskType(d["task_type"])
+    return TimerTask(**d)
+
+
+def replication_to_json(t: ReplicationTask) -> str:
+    d = dataclasses.asdict(t)
+    d["branch_token"] = t.branch_token.decode("latin-1")
+    d["new_run_branch_token"] = t.new_run_branch_token.decode("latin-1")
+    return json.dumps(d)
+
+
+def replication_from_json(s: str) -> ReplicationTask:
+    d = json.loads(s)
+    d["branch_token"] = d["branch_token"].encode("latin-1")
+    d["new_run_branch_token"] = d["new_run_branch_token"].encode("latin-1")
+    return ReplicationTask(**d)

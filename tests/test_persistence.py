@@ -1,0 +1,427 @@
+"""Cross-backend persistence conformance suite.
+
+One suite, N backends — the reference's persistence-tests pattern
+(/root/reference/common/persistence/persistence-tests/): every test runs
+against both the memory and sqlite bundles via the fixture param."""
+
+import pytest
+
+from cadence_tpu.core import history_factory as F
+from cadence_tpu.core.enums import TimerTaskType, TransferTaskType
+from cadence_tpu.core.tasks import ReplicationTask, TimerTask, TransferTask
+from cadence_tpu.runtime.persistence import (
+    ConditionFailedError,
+    CreateWorkflowMode,
+    DomainAlreadyExistsError,
+    DomainConfig,
+    DomainInfo,
+    DomainRecord,
+    DomainReplicationConfig,
+    EntityNotExistsError,
+    ShardInfo,
+    ShardOwnershipLostError,
+    TaskInfo,
+    TaskListLeaseLostError,
+    TaskType,
+    VisibilityRecord,
+    WorkflowAlreadyStartedError,
+    WorkflowSnapshot,
+    create_memory_bundle,
+    create_sqlite_bundle,
+)
+
+SHARD = 1
+RANGE = 1
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def bundle(request, tmp_path):
+    if request.param == "memory":
+        b = create_memory_bundle()
+    else:
+        b = create_sqlite_bundle(str(tmp_path / "store.db"))
+    b.shard.create_shard(ShardInfo(shard_id=SHARD, range_id=RANGE))
+    yield b
+    b.close()
+
+
+def make_snapshot(
+    wf="wf1", run="run1", domain="dom", next_event_id=3, state=1,
+    close_status=0, request_id="req1", tasks=False, last_write_version=0,
+):
+    snap = {
+        "exec": {"state": state, "close_status": close_status},
+        "request_id": request_id,
+    }
+    return WorkflowSnapshot(
+        domain_id=domain,
+        workflow_id=wf,
+        run_id=run,
+        snapshot=snap,
+        next_event_id=next_event_id,
+        last_write_version=last_write_version,
+        transfer_tasks=(
+            [
+                TransferTask(
+                    task_type=TransferTaskType.DecisionTask,
+                    domain_id=domain, workflow_id=wf, run_id=run,
+                    task_id=100, task_list="tl", schedule_id=2,
+                )
+            ]
+            if tasks
+            else []
+        ),
+        timer_tasks=(
+            [
+                TimerTask(
+                    task_type=TimerTaskType.WorkflowTimeout,
+                    visibility_timestamp=5000, domain_id=domain,
+                    workflow_id=wf, run_id=run, task_id=101,
+                )
+            ]
+            if tasks
+            else []
+        ),
+    )
+
+
+# -- shard ---------------------------------------------------------------
+
+
+def test_shard_crud(bundle):
+    info = bundle.shard.get_shard(SHARD)
+    assert info.range_id == RANGE
+    info.range_id = 2
+    bundle.shard.update_shard(info, previous_range_id=RANGE)
+    assert bundle.shard.get_shard(SHARD).range_id == 2
+    # stale update fenced
+    info.range_id = 3
+    with pytest.raises(ShardOwnershipLostError):
+        bundle.shard.update_shard(info, previous_range_id=RANGE)
+    with pytest.raises(EntityNotExistsError):
+        bundle.shard.get_shard(99)
+
+
+# -- executions ----------------------------------------------------------
+
+
+def test_create_get_update_execution(bundle):
+    ex = bundle.execution
+    snap = make_snapshot(tasks=True)
+    ex.create_workflow_execution(SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, snap)
+
+    got = ex.get_workflow_execution(SHARD, "dom", "wf1", "run1")
+    assert got.next_event_id == 3
+    assert got.snapshot["exec"]["state"] == 1
+
+    cur = ex.get_current_execution(SHARD, "dom", "wf1")
+    assert cur.run_id == "run1" and cur.state == 1
+
+    # brand-new again fails with started error carrying run id
+    with pytest.raises(WorkflowAlreadyStartedError) as ei:
+        ex.create_workflow_execution(
+            SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, make_snapshot()
+        )
+    assert ei.value.run_id == "run1"
+
+    # conditional update: wrong condition fails
+    mut = make_snapshot(next_event_id=5)
+    with pytest.raises(ConditionFailedError):
+        ex.update_workflow_execution(SHARD, RANGE, 99, mut)
+    ex.update_workflow_execution(SHARD, RANGE, 3, mut)
+    assert ex.get_workflow_execution(SHARD, "dom", "wf1", "run1").next_event_id == 5
+
+    # fenced by newer range_id
+    info = bundle.shard.get_shard(SHARD)
+    info.range_id = 10
+    bundle.shard.update_shard(info, previous_range_id=RANGE)
+    with pytest.raises(ShardOwnershipLostError):
+        ex.update_workflow_execution(SHARD, RANGE, 5, make_snapshot(next_event_id=7))
+
+
+def test_workflow_id_reuse(bundle):
+    ex = bundle.execution
+    ex.create_workflow_execution(
+        SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, make_snapshot()
+    )
+    # reuse while running -> already started
+    with pytest.raises(WorkflowAlreadyStartedError):
+        ex.create_workflow_execution(
+            SHARD, RANGE, CreateWorkflowMode.WORKFLOW_ID_REUSE,
+            make_snapshot(run="run2"), prev_run_id="run1",
+        )
+    # close it, then reuse works
+    ex.update_workflow_execution(
+        SHARD, RANGE, 3, make_snapshot(next_event_id=4, state=2, close_status=1)
+    )
+    ex.create_workflow_execution(
+        SHARD, RANGE, CreateWorkflowMode.WORKFLOW_ID_REUSE,
+        make_snapshot(run="run2"), prev_run_id="run1",
+    )
+    assert ex.get_current_execution(SHARD, "dom", "wf1").run_id == "run2"
+
+
+def test_continue_as_new_atomic(bundle):
+    ex = bundle.execution
+    ex.create_workflow_execution(
+        SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, make_snapshot()
+    )
+    old = make_snapshot(next_event_id=6, state=2, close_status=5)
+    new = make_snapshot(run="run2", next_event_id=3)
+    ex.update_workflow_execution(
+        SHARD, RANGE, 3, old, new_snapshot=new,
+        new_mode=CreateWorkflowMode.CONTINUE_AS_NEW,
+    )
+    assert ex.get_current_execution(SHARD, "dom", "wf1").run_id == "run2"
+    # both concrete runs exist
+    assert ex.get_workflow_execution(SHARD, "dom", "wf1", "run1").next_event_id == 6
+    assert ex.get_workflow_execution(SHARD, "dom", "wf1", "run2").next_event_id == 3
+
+
+def test_transfer_timer_queues(bundle):
+    ex = bundle.execution
+    ex.create_workflow_execution(
+        SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, make_snapshot(tasks=True)
+    )
+    tasks = ex.get_transfer_tasks(SHARD, 0, 10_000, 10)
+    assert len(tasks) == 1 and tasks[0].task_id == 100
+    assert tasks[0].task_type == TransferTaskType.DecisionTask
+    ex.complete_transfer_task(SHARD, 100)
+    assert ex.get_transfer_tasks(SHARD, 0, 10_000, 10) == []
+
+    timers = ex.get_timer_tasks(SHARD, 0, 10_000, 10)
+    assert len(timers) == 1 and timers[0].visibility_timestamp == 5000
+    # window below the timer sees nothing
+    assert ex.get_timer_tasks(SHARD, 0, 5000, 10) == []
+    ex.complete_timer_task(SHARD, 5000, 101)
+    assert ex.get_timer_tasks(SHARD, 0, 10_000, 10) == []
+
+
+def test_replication_queue(bundle):
+    ex = bundle.execution
+    snap = make_snapshot()
+    snap.replication_tasks = [
+        ReplicationTask(
+            domain_id="dom", workflow_id="wf1", run_id="run1", task_id=7,
+            first_event_id=1, next_event_id=3, version=10,
+            branch_token=b"\x01\x02",
+        )
+    ]
+    ex.create_workflow_execution(SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, snap)
+    tasks = ex.get_replication_tasks(SHARD, 0, 10)
+    assert len(tasks) == 1 and tasks[0].branch_token == b"\x01\x02"
+    ex.complete_replication_task(SHARD, 7)
+    assert ex.get_replication_tasks(SHARD, 0, 10) == []
+
+
+def test_delete_execution(bundle):
+    ex = bundle.execution
+    ex.create_workflow_execution(
+        SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, make_snapshot()
+    )
+    ex.delete_current_workflow_execution(SHARD, "dom", "wf1", "run1")
+    with pytest.raises(EntityNotExistsError):
+        ex.get_current_execution(SHARD, "dom", "wf1")
+    ex.delete_workflow_execution(SHARD, "dom", "wf1", "run1")
+    with pytest.raises(EntityNotExistsError):
+        ex.get_workflow_execution(SHARD, "dom", "wf1", "run1")
+
+
+# -- history tree --------------------------------------------------------
+
+
+def _events(first_id, n, v=10, t=1_700_000_000_000_000_000):
+    return [
+        F.marker_recorded(first_id + i, v, t, decision_task_completed_event_id=1)
+        for i in range(n)
+    ]
+
+
+def test_history_append_read(bundle):
+    h = bundle.history
+    branch = h.new_history_branch("tree1")
+    h.append_history_nodes(branch, _events(1, 3), transaction_id=1)
+    h.append_history_nodes(branch, _events(4, 2), transaction_id=2)
+    batches, token = h.read_history_branch(branch, 1, 10_000)
+    assert token == 0
+    assert [b[0].event_id for b in batches] == [1, 4]
+    # paginated
+    batches, token = h.read_history_branch(branch, 1, 10_000, page_size=1)
+    assert len(batches) == 1 and token == 4
+    batches, token = h.read_history_branch(
+        branch, 1, 10_000, page_size=1, next_token=token
+    )
+    assert batches[0][0].event_id == 4 and token == 0
+
+
+def test_history_txn_id_wins(bundle):
+    h = bundle.history
+    branch = h.new_history_branch("tree1")
+    h.append_history_nodes(branch, _events(1, 2, v=10), transaction_id=5)
+    # lower transaction id loses
+    h.append_history_nodes(branch, _events(1, 3, v=20), transaction_id=3)
+    batches, _ = h.read_history_branch(branch, 1, 100)
+    assert len(batches[0]) == 2 and batches[0][0].version == 10
+    # higher wins
+    h.append_history_nodes(branch, _events(1, 3, v=30), transaction_id=9)
+    batches, _ = h.read_history_branch(branch, 1, 100)
+    assert len(batches[0]) == 3 and batches[0][0].version == 30
+
+
+def test_history_fork(bundle):
+    h = bundle.history
+    main = h.new_history_branch("tree1")
+    h.append_history_nodes(main, _events(1, 3), transaction_id=1)
+    h.append_history_nodes(main, _events(4, 3), transaction_id=2)
+    h.append_history_nodes(main, _events(7, 3), transaction_id=3)
+
+    fork = h.fork_history_branch(main, fork_node_id=7)
+    # fork sees ancestor nodes below 7 only
+    batches, _ = h.read_history_branch(fork, 1, 10_000)
+    assert [b[0].event_id for b in batches] == [1, 4]
+    # write to the fork; main is unaffected
+    h.append_history_nodes(fork, _events(7, 2, v=99), transaction_id=4)
+    fork_batches, _ = h.read_history_branch(fork, 1, 10_000)
+    assert [b[0].event_id for b in fork_batches] == [1, 4, 7]
+    assert fork_batches[-1][0].version == 99
+    main_batches, _ = h.read_history_branch(main, 1, 10_000)
+    assert main_batches[-1][0].version == 10
+
+    assert len(h.get_history_tree("tree1")) == 2
+    h.delete_history_branch(fork)
+    assert len(h.get_history_tree("tree1")) == 1
+
+
+# -- matching tasks ------------------------------------------------------
+
+
+def test_task_list_lease_and_tasks(bundle):
+    tm = bundle.task
+    info = tm.lease_task_list("dom", "tl", TaskType.DECISION)
+    assert info.range_id == 1
+    info2 = tm.lease_task_list("dom", "tl", TaskType.DECISION)
+    assert info2.range_id == 2
+    # the old lease can no longer write
+    with pytest.raises(TaskListLeaseLostError):
+        tm.create_tasks(info, [TaskInfo("dom", "wf1", "run1", 1, 2)])
+    tm.create_tasks(
+        info2,
+        [
+            TaskInfo("dom", "wf1", "run1", 1, 2),
+            TaskInfo("dom", "wf2", "run2", 2, 2),
+        ],
+    )
+    tasks = tm.get_tasks("dom", "tl", TaskType.DECISION, 0, 100, 10)
+    assert [t.task_id for t in tasks] == [1, 2]
+    tm.complete_task("dom", "tl", TaskType.DECISION, 1)
+    assert len(tm.get_tasks("dom", "tl", TaskType.DECISION, 0, 100, 10)) == 1
+    assert tm.complete_tasks_less_than("dom", "tl", TaskType.DECISION, 100) == 1
+
+    info2.ack_level = 2
+    tm.update_task_list(info2)
+    lists = tm.list_task_lists()
+    assert len(lists) == 1 and lists[0].ack_level == 2
+    tm.delete_task_list("dom", "tl", TaskType.DECISION, info2.range_id)
+    assert tm.list_task_lists() == []
+
+
+# -- domains -------------------------------------------------------------
+
+
+def _domain(name="dom1"):
+    return DomainRecord(
+        info=DomainInfo(id="", name=name, description="d"),
+        config=DomainConfig(retention_days=3),
+        replication_config=DomainReplicationConfig(),
+    )
+
+
+def test_domain_crud(bundle):
+    md = bundle.metadata
+    did = md.create_domain(_domain())
+    with pytest.raises(DomainAlreadyExistsError):
+        md.create_domain(_domain())
+    rec = md.get_domain(name="dom1")
+    assert rec.info.id == did and rec.config.retention_days == 3
+    assert md.get_domain(id=did).info.name == "dom1"
+
+    v0 = rec.notification_version
+    rec.config.retention_days = 9
+    md.update_domain(rec)
+    rec2 = md.get_domain(id=did)
+    assert rec2.config.retention_days == 9
+    assert rec2.notification_version > v0
+    assert md.get_metadata_version() >= 2
+
+    assert len(md.list_domains()) == 1
+    md.delete_domain(name="dom1")
+    with pytest.raises(EntityNotExistsError):
+        md.get_domain(name="dom1")
+
+
+# -- visibility ----------------------------------------------------------
+
+
+def test_visibility_lifecycle(bundle):
+    vis = bundle.visibility
+    for i in range(3):
+        vis.record_workflow_execution_started(
+            VisibilityRecord(
+                domain_id="dom", workflow_id=f"wf{i}", run_id=f"run{i}",
+                workflow_type="echo", start_time=1000 + i,
+            )
+        )
+    open_recs, _ = vis.list_open_workflow_executions("dom")
+    assert len(open_recs) == 3
+    assert open_recs[0].workflow_id == "wf2"  # start_time desc
+
+    vis.record_workflow_execution_closed(
+        VisibilityRecord(
+            domain_id="dom", workflow_id="wf1", run_id="run1",
+            workflow_type="echo", start_time=1001, close_time=2000,
+            close_status=0, history_length=10,
+        )
+    )
+    open_recs, _ = vis.list_open_workflow_executions("dom")
+    assert len(open_recs) == 2
+    closed, _ = vis.list_closed_workflow_executions("dom")
+    assert len(closed) == 1 and closed[0].history_length == 10
+    closed, _ = vis.list_closed_workflow_executions("dom", close_status=0)
+    assert len(closed) == 1
+    closed, _ = vis.list_closed_workflow_executions("dom", close_status=1)
+    assert closed == []
+
+    got = vis.get_closed_workflow_execution("dom", "wf1", "")
+    assert got.run_id == "run1"
+    assert vis.count_workflow_executions("dom") == 3
+    assert vis.count_workflow_executions("dom", open_only=True) == 2
+
+    by_id, _ = vis.list_open_workflow_executions("dom", workflow_id="wf0")
+    assert len(by_id) == 1
+
+    vis.delete_workflow_execution("dom", "wf1", "run1")
+    with pytest.raises(EntityNotExistsError):
+        vis.get_closed_workflow_execution("dom", "wf1", "run1")
+
+
+def test_visibility_pagination(bundle):
+    vis = bundle.visibility
+    for i in range(5):
+        vis.record_workflow_execution_started(
+            VisibilityRecord(
+                domain_id="dom", workflow_id=f"wf{i}", run_id=f"r{i}",
+                workflow_type="echo", start_time=i,
+            )
+        )
+    page1, token = vis.list_open_workflow_executions("dom", page_size=2)
+    assert len(page1) == 2 and token
+    page2, token = vis.list_open_workflow_executions(
+        "dom", page_size=2, next_token=token
+    )
+    assert len(page2) == 2 and token
+    page3, token = vis.list_open_workflow_executions(
+        "dom", page_size=2, next_token=token
+    )
+    assert len(page3) == 1 and token == 0
+    ids = [r.workflow_id for r in page1 + page2 + page3]
+    assert ids == ["wf4", "wf3", "wf2", "wf1", "wf0"]
