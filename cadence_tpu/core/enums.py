@@ -84,6 +84,15 @@ class DecisionType(enum.IntEnum):
     UpsertWorkflowSearchAttributes = 12
 
 
+class ContinueAsNewInitiator(enum.IntEnum):
+    """Why a run continued-as-new (reference: shared.thrift
+    ContinueAsNewInitiator; stateBuilder treats 2 == CronSchedule)."""
+
+    Decider = 0
+    RetryPolicy = 1
+    CronSchedule = 2
+
+
 class TimeoutType(enum.IntEnum):
     StartToClose = 0
     ScheduleToStart = 1

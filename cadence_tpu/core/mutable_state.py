@@ -906,6 +906,12 @@ class MutableState:
             },
             "signal_requested_ids": sorted(self.signal_requested_ids),
             "current_version": self.current_version,
+            "buffered_events": [e.to_dict() for e in self.buffered_events],
+            "version_histories": (
+                self.version_histories.to_dict()
+                if self.version_histories is not None
+                else None
+            ),
         }
 
     @classmethod
@@ -933,4 +939,12 @@ class MutableState:
             ms.pending_signals[int(k)] = SignalInfo(**v)
         ms.signal_requested_ids = set(snap.get("signal_requested_ids", []))
         ms.current_version = snap.get("current_version", EMPTY_VERSION)
+        ms.buffered_events = [
+            HistoryEvent.from_dict(d) for d in snap.get("buffered_events", [])
+        ]
+        vh = snap.get("version_histories")
+        if vh is not None:
+            from .version_history import VersionHistories
+
+            ms.version_histories = VersionHistories.from_dict(vh)
         return ms

@@ -1,0 +1,376 @@
+"""Active-path transactions: event creation + close-replay through the
+shared StateBuilder, buffered events, transient decisions, lazy activity
+started materialization."""
+
+import pytest
+
+from cadence_tpu.core.active_transaction import (
+    ActiveTransaction,
+    WorkflowStateError,
+)
+from cadence_tpu.core.enums import (
+    CloseStatus,
+    EventType,
+    TimeoutType,
+    TransferTaskType,
+    TimerTaskType,
+    WorkflowState,
+)
+from cadence_tpu.core.ids import EMPTY_EVENT_ID, TRANSIENT_EVENT_ID
+from cadence_tpu.core.mutable_state import SECOND, MutableState
+
+T0 = 1_700_000_000 * SECOND
+V = -24  # EMPTY_VERSION: local (non-global) domain
+
+
+def txn(ms, request_id="req"):
+    return ActiveTransaction(
+        ms, "dom", "wf1", "run1", V, request_id=request_id,
+        id_generator=lambda: "fixed",
+    )
+
+
+def start_workflow(ms=None):
+    """Start transaction: Started + DecisionTaskScheduled."""
+    ms = ms or MutableState(domain_id="dom")
+    t = txn(ms)
+    t.add_workflow_execution_started(
+        T0, workflow_type="echo", task_list="tl",
+        execution_start_to_close_timeout_seconds=3600,
+        task_start_to_close_timeout_seconds=10,
+    )
+    t.add_decision_task_scheduled(T0)
+    result = t.close()
+    return ms, result
+
+
+def start_decision(ms, now=T0 + SECOND):
+    t = txn(ms)
+    d = t.add_decision_task_started(
+        ms.execution_info.decision_schedule_id, "poll-req", "worker", now
+    )
+    return t.close(), d
+
+
+def test_start_transaction():
+    ms, result = start_workflow()
+    assert [e.event_type for e in result.events] == [
+        EventType.WorkflowExecutionStarted,
+        EventType.DecisionTaskScheduled,
+    ]
+    assert [e.event_id for e in result.events] == [1, 2]
+    assert ms.next_event_id == 3
+    # Created until the first decision starts (reference semantics)
+    assert ms.execution_info.state == WorkflowState.Created
+    assert ms.is_workflow_execution_running()
+    assert ms.has_pending_decision() and not ms.has_inflight_decision()
+    kinds = [t.task_type for t in result.transfer_tasks]
+    assert TransferTaskType.RecordWorkflowStarted in kinds
+    assert TransferTaskType.DecisionTask in kinds
+    assert any(
+        t.task_type == TimerTaskType.WorkflowTimeout for t in result.timer_tasks
+    )
+
+
+def test_decision_round_trip_with_activity():
+    ms, _ = start_workflow()
+    result, _ = start_decision(ms)
+    assert result.events[0].event_type == EventType.DecisionTaskStarted
+    assert ms.has_inflight_decision()
+    # decision timeout timer generated
+    assert any(
+        t.task_type == TimerTaskType.DecisionTimeout for t in result.timer_tasks
+    )
+
+    # complete decision scheduling one activity
+    t = txn(ms)
+    completed = t.add_decision_task_completed(2, 3, T0 + 2 * SECOND)
+    t.add_activity_task_scheduled(
+        completed.event_id, T0 + 2 * SECOND, activity_id="a1",
+        task_list="tl", start_to_close_timeout_seconds=30,
+        schedule_to_start_timeout_seconds=10,
+        schedule_to_close_timeout_seconds=60,
+    )
+    result = t.close()
+    assert [e.event_id for e in result.events] == [4, 5]
+    assert not ms.has_pending_decision()
+    assert 5 in ms.pending_activities
+    assert any(
+        t.task_type == TransferTaskType.ActivityTask
+        for t in result.transfer_tasks
+    )
+
+    # activity starts: state-only
+    t = txn(ms)
+    ai = ms.get_activity_info(5)
+    t.record_activity_task_started(ai, "poll-1", "worker", T0 + 3 * SECOND)
+    result = t.close()
+    assert result.events == []
+    assert ms.get_activity_info(5).started_id == TRANSIENT_EVENT_ID
+
+    # activity completes: started event materializes before completed
+    t = txn(ms)
+    t.add_activity_task_completed(5, T0 + 4 * SECOND, result=b"ok")
+    t.add_decision_task_scheduled(T0 + 4 * SECOND)
+    result = t.close()
+    assert [e.event_type for e in result.events] == [
+        EventType.ActivityTaskStarted,
+        EventType.ActivityTaskCompleted,
+        EventType.DecisionTaskScheduled,
+    ]
+    assert [e.event_id for e in result.events] == [6, 7, 8]
+    assert 5 not in ms.pending_activities
+
+
+def close_workflow(ms):
+    result, _ = start_decision(ms, now=T0 + 5 * SECOND)
+    sched = ms.execution_info.decision_schedule_id
+    started = ms.execution_info.decision_started_id
+    t = txn(ms)
+    completed = t.add_decision_task_completed(sched, started, T0 + 6 * SECOND)
+    t.add_workflow_execution_completed(
+        completed.event_id, T0 + 6 * SECOND, result=b"done"
+    )
+    return t.close()
+
+
+def test_workflow_complete():
+    ms, _ = start_workflow()
+    result = close_workflow(ms)
+    assert result.events[-1].event_type == EventType.WorkflowExecutionCompleted
+    assert ms.execution_info.state == WorkflowState.Completed
+    assert ms.execution_info.close_status == CloseStatus.Completed
+    assert any(
+        t.task_type == TransferTaskType.CloseExecution
+        for t in result.transfer_tasks
+    )
+    assert any(
+        t.task_type == TimerTaskType.DeleteHistoryEvent
+        for t in result.timer_tasks
+    )
+    # further mutations rejected
+    t = txn(ms)
+    with pytest.raises(WorkflowStateError):
+        t.add_workflow_execution_signaled("s", b"", "", T0 + 7 * SECOND)
+
+
+def test_signal_buffered_while_decision_inflight():
+    ms, _ = start_workflow()
+    start_decision(ms)
+
+    # signal arrives mid-decision: buffered, no event id yet
+    t = txn(ms)
+    t.add_workflow_execution_signaled("sig", b"x", "client", T0 + 2 * SECOND)
+    result = t.close()
+    assert result.events == []
+    assert len(ms.buffered_events) == 1
+    assert ms.execution_info.signal_count == 0  # applied at flush
+
+    # decision completes: buffered signal flushes right after
+    t = txn(ms)
+    t.add_decision_task_completed(2, 3, T0 + 3 * SECOND)
+    result = t.close()
+    assert [e.event_type for e in result.events] == [
+        EventType.DecisionTaskCompleted,
+        EventType.WorkflowExecutionSignaled,
+    ]
+    assert [e.event_id for e in result.events] == [4, 5]
+    assert ms.buffered_events == []
+    assert ms.execution_info.signal_count == 1
+
+
+def test_signal_not_buffered_without_inflight_decision():
+    ms, _ = start_workflow()
+    t = txn(ms)
+    t.add_workflow_execution_signaled("sig", b"x", "client", T0 + SECOND)
+    result = t.close()
+    assert [e.event_type for e in result.events] == [
+        EventType.WorkflowExecutionSignaled
+    ]
+    assert ms.execution_info.signal_count == 1
+
+
+def test_transient_decision_after_failure():
+    ms, _ = start_workflow()
+    start_decision(ms)
+    # fail the decision: close-replay auto-schedules the transient retry
+    # (StateBuilder mirrors reference stateBuilder.go:227-258)
+    t = txn(ms)
+    t.add_decision_task_failed(2, 3, T0 + 2 * SECOND)
+    result = t.close()
+    assert result.events[-1].event_type == EventType.DecisionTaskFailed
+    assert ms.execution_info.decision_attempt == 1
+    assert ms.has_pending_decision()
+    assert any(
+        tt.task_type == TransferTaskType.DecisionTask
+        for tt in result.transfer_tasks
+    )
+    sched = ms.execution_info.decision_schedule_id
+    assert sched == ms.next_event_id  # transient shadow id
+
+    # transient started: no event
+    t = txn(ms)
+    t.add_decision_task_started(sched, "poll2", "worker", T0 + 4 * SECOND)
+    result = t.close()
+    assert result.events == []
+    assert ms.has_inflight_decision()
+
+    # completion materializes scheduled+started at the batch front
+    t = txn(ms)
+    completed = t.add_decision_task_completed(
+        sched, sched + 1, T0 + 5 * SECOND
+    )
+    t.add_workflow_execution_completed(completed.event_id, T0 + 5 * SECOND)
+    result = t.close()
+    assert [e.event_type for e in result.events] == [
+        EventType.DecisionTaskScheduled,
+        EventType.DecisionTaskStarted,
+        EventType.DecisionTaskCompleted,
+        EventType.WorkflowExecutionCompleted,
+    ]
+    assert result.events[0].attributes["attempt"] == 1
+    assert result.events[0].event_id == sched
+
+
+def test_activity_result_buffered_while_decision_inflight():
+    ms, _ = start_workflow()
+    # schedule activity via first decision
+    result, _ = start_decision(ms)
+    t = txn(ms)
+    completed = t.add_decision_task_completed(2, 3, T0 + 2 * SECOND)
+    t.add_activity_task_scheduled(
+        completed.event_id, T0 + 2 * SECOND, activity_id="a1"
+    )
+    t.add_decision_task_scheduled(T0 + 2 * SECOND)
+    t.close()
+    sched_id = ms.activity_by_id["a1"]
+    ai = ms.get_activity_info(sched_id)
+    t = txn(ms)
+    t.record_activity_task_started(ai, "p", "w", T0 + 3 * SECOND)
+    t.close()
+    # second decision starts
+    start_decision(ms, now=T0 + 4 * SECOND)
+
+    # activity completes while decision 2 in flight: started+completed buffer
+    t = txn(ms)
+    t.add_activity_task_completed(sched_id, T0 + 5 * SECOND)
+    result = t.close()
+    assert result.events == []
+    assert len(ms.buffered_events) == 2
+    # double completion rejected while buffered
+    t = txn(ms)
+    with pytest.raises(WorkflowStateError):
+        t.add_activity_task_completed(sched_id, T0 + 5 * SECOND)
+
+    # decision completes: buffer flushes in order
+    sched = ms.execution_info.decision_schedule_id
+    started = ms.execution_info.decision_started_id
+    t = txn(ms)
+    t.add_decision_task_completed(sched, started, T0 + 6 * SECOND)
+    result = t.close()
+    types = [e.event_type for e in result.events]
+    assert types == [
+        EventType.DecisionTaskCompleted,
+        EventType.ActivityTaskStarted,
+        EventType.ActivityTaskCompleted,
+    ]
+    assert sched_id not in ms.pending_activities
+
+
+def test_timer_lifecycle():
+    ms, _ = start_workflow()
+    start_decision(ms)
+    t = txn(ms)
+    completed = t.add_decision_task_completed(2, 3, T0 + 2 * SECOND)
+    t.add_timer_started(completed.event_id, "t1", 60, T0 + 2 * SECOND)
+    with pytest.raises(WorkflowStateError):
+        t.add_timer_started(completed.event_id, "t1", 60, T0 + 2 * SECOND)
+    result = t.close()
+    assert "t1" in ms.pending_timers
+    assert any(
+        tt.task_type == TimerTaskType.UserTimer for tt in result.timer_tasks
+    )
+
+    t = txn(ms)
+    t.add_timer_fired("t1", T0 + 62 * SECOND)
+    t.add_decision_task_scheduled(T0 + 62 * SECOND)
+    result = t.close()
+    assert result.events[0].event_type == EventType.TimerFired
+    assert "t1" not in ms.pending_timers
+
+
+def test_cancel_timer_unknown_emits_failed():
+    ms, _ = start_workflow()
+    start_decision(ms)
+    t = txn(ms)
+    completed = t.add_decision_task_completed(2, 3, T0 + 2 * SECOND)
+    ev = t.add_timer_canceled(completed.event_id, "nope", T0 + 2 * SECOND)
+    assert ev.event_type == EventType.CancelTimerFailed
+    t.close()
+
+
+def test_continue_as_new():
+    ms, _ = start_workflow()
+    start_decision(ms)
+    t = txn(ms)
+    completed = t.add_decision_task_completed(2, 3, T0 + 2 * SECOND)
+    t.add_continued_as_new(
+        completed.event_id, T0 + 2 * SECOND, "run2",
+        workflow_type="echo", task_list="tl",
+        execution_start_to_close_timeout_seconds=3600,
+        task_start_to_close_timeout_seconds=10,
+    )
+    result = t.close()
+    assert ms.execution_info.close_status == CloseStatus.ContinuedAsNew
+    assert result.new_run_ms is not None
+    assert result.new_run_ms.is_workflow_execution_running()
+    assert [e.event_type for e in result.new_run_events] == [
+        EventType.WorkflowExecutionStarted,
+        EventType.DecisionTaskScheduled,
+    ]
+    assert any(
+        t.task_type == TransferTaskType.DecisionTask
+        for t in result.new_run_transfer_tasks
+    )
+
+
+def test_terminate_flushes_buffer():
+    ms, _ = start_workflow()
+    start_decision(ms)
+    t = txn(ms)
+    t.add_workflow_execution_signaled("sig", b"", "", T0 + 2 * SECOND)
+    t.close()
+    assert len(ms.buffered_events) == 1
+    t = txn(ms)
+    t.add_workflow_execution_terminated(T0 + 3 * SECOND, reason="ops")
+    result = t.close()
+    assert [e.event_type for e in result.events] == [
+        EventType.WorkflowExecutionSignaled,
+        EventType.WorkflowExecutionTerminated,
+    ]
+    assert ms.execution_info.close_status == CloseStatus.Terminated
+    assert ms.execution_info.signal_count == 1
+
+
+def test_cancel_request_dedup():
+    ms, _ = start_workflow()
+    t = txn(ms)
+    t.add_workflow_execution_cancel_requested("user", "cli", T0 + SECOND)
+    t.close()
+    assert ms.execution_info.cancel_requested
+    t = txn(ms)
+    with pytest.raises(WorkflowStateError):
+        t.add_workflow_execution_cancel_requested("user", "cli", T0 + SECOND)
+
+
+def test_snapshot_roundtrip_with_buffered():
+    ms, _ = start_workflow()
+    start_decision(ms)
+    t = txn(ms)
+    t.add_workflow_execution_signaled("sig", b"payload", "", T0 + 2 * SECOND)
+    t.close()
+    snap = ms.snapshot()
+    ms2 = MutableState.from_snapshot(snap)
+    assert len(ms2.buffered_events) == 1
+    assert ms2.buffered_events[0].attributes["input"] == b"payload"
+    assert ms2.snapshot() == snap
