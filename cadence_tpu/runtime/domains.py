@@ -1,0 +1,93 @@
+"""Domain cache + registry operations.
+
+Reference: common/cache/domainCache.go (notification-version-driven LRU)
++ common/domain/handler.go (CRUD/failover). The cache refreshes entries
+when the metadata notification version moves — same contract, simpler
+machinery."""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from .persistence.errors import EntityNotExistsError
+from .persistence.interfaces import MetadataManager
+from .persistence.records import (
+    DomainConfig,
+    DomainInfo,
+    DomainRecord,
+    DomainReplicationConfig,
+)
+
+
+class DomainCache:
+    def __init__(self, metadata: MetadataManager) -> None:
+        self.metadata = metadata
+        self._lock = threading.RLock()
+        self._by_id: Dict[str, DomainRecord] = {}
+        self._by_name: Dict[str, DomainRecord] = {}
+        self._version = -1
+
+    def _refresh_if_stale(self) -> None:
+        v = self.metadata.get_metadata_version()
+        with self._lock:
+            if v == self._version:
+                return
+            self._by_id.clear()
+            self._by_name.clear()
+            for rec in self.metadata.list_domains():
+                self._by_id[rec.info.id] = rec
+                self._by_name[rec.info.name] = rec
+            self._version = v
+
+    def get_by_id(self, domain_id: str) -> DomainRecord:
+        self._refresh_if_stale()
+        with self._lock:
+            rec = self._by_id.get(domain_id)
+        if rec is None:
+            raise EntityNotExistsError(f"domain {domain_id}")
+        return rec
+
+    def get_by_name(self, name: str) -> DomainRecord:
+        self._refresh_if_stale()
+        with self._lock:
+            rec = self._by_name.get(name)
+        if rec is None:
+            raise EntityNotExistsError(f"domain {name}")
+        return rec
+
+    def get_domain_id(self, name: str) -> str:
+        return self.get_by_name(name).info.id
+
+    def resolve(self, name_or_id: str) -> DomainRecord:
+        self._refresh_if_stale()
+        with self._lock:
+            rec = self._by_name.get(name_or_id) or self._by_id.get(name_or_id)
+        if rec is None:
+            raise EntityNotExistsError(f"domain {name_or_id}")
+        return rec
+
+
+def register_domain(
+    metadata: MetadataManager,
+    name: str,
+    retention_days: int = 7,
+    description: str = "",
+    is_global: bool = False,
+    clusters: Optional[List[str]] = None,
+    active_cluster: str = "active",
+) -> str:
+    """Domain registration (reference: domain/handler.go RegisterDomain)."""
+    rec = DomainRecord(
+        info=DomainInfo(
+            id=str(uuid.uuid4()), name=name, description=description
+        ),
+        config=DomainConfig(retention_days=retention_days),
+        replication_config=DomainReplicationConfig(
+            active_cluster_name=active_cluster,
+            clusters=list(clusters or [active_cluster]),
+        ),
+        is_global=is_global,
+    )
+    return metadata.create_domain(rec)

@@ -1,0 +1,47 @@
+"""Per-workflow execution contexts with pinned locks.
+
+Reference: service/history/historyCache.go — an LRU of
+workflowExecutionContext; callers pin an entry, take its lock, mutate,
+release. Eviction only removes unpinned, unlocked entries."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Tuple
+
+from .context import WorkflowExecutionContext
+
+
+class HistoryCache:
+    def __init__(self, make_context: Callable[..., WorkflowExecutionContext],
+                 max_size: int = 1024) -> None:
+        self._make = make_context
+        self._max = max_size
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple[str, str, str], WorkflowExecutionContext]" = (
+            OrderedDict()
+        )
+
+    def get_or_create(
+        self, domain_id: str, workflow_id: str, run_id: str
+    ) -> WorkflowExecutionContext:
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            ctx = self._entries.get(key)
+            if ctx is None:
+                ctx = self._make(domain_id, workflow_id, run_id)
+                self._entries[key] = ctx
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._max:
+                old_key, old_ctx = next(iter(self._entries.items()))
+                if old_ctx.lock.acquire(blocking=False):
+                    old_ctx.lock.release()
+                    del self._entries[old_key]
+                else:
+                    break  # oldest is busy; skip eviction this round
+            return ctx
+
+    def evict(self, domain_id: str, workflow_id: str, run_id: str) -> None:
+        with self._lock:
+            self._entries.pop((domain_id, workflow_id, run_id), None)

@@ -1,0 +1,183 @@
+"""Workflow execution context: load / persist orchestration.
+
+Reference: service/history/workflowExecutionContext.go — the component
+that knows how a closed ActiveTransaction becomes durable: append the
+event batch to the history branch, stamp queue-task IDs from the shard
+sequencer, then write the mutable-state snapshot conditioned on the
+load-time next_event_id (and the shard's range_id), creating the
+continue-as-new run atomically when present."""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from cadence_tpu.core.active_transaction import TransactionResult
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.mutable_state import MutableState
+
+from ..persistence.records import (
+    BranchToken,
+    CreateWorkflowMode,
+    WorkflowSnapshot,
+)
+from ..shard import ShardContext
+
+
+class WorkflowExecutionContext:
+    def __init__(
+        self,
+        shard: ShardContext,
+        domain_id: str,
+        workflow_id: str,
+        run_id: str,
+    ) -> None:
+        self.shard = shard
+        self.domain_id = domain_id
+        self.workflow_id = workflow_id
+        self.run_id = run_id
+        self.lock = threading.RLock()
+        self._ms: Optional[MutableState] = None
+        self._condition = 0
+
+    # -- load ---------------------------------------------------------
+
+    def load(self) -> MutableState:
+        if self._ms is None:
+            resp = self.shard.persistence.execution.get_workflow_execution(
+                self.shard.shard_id, self.domain_id, self.workflow_id,
+                self.run_id,
+            )
+            self._ms = MutableState.from_snapshot(resp.snapshot)
+            self._condition = resp.next_event_id
+        return self._ms
+
+    def clear(self) -> None:
+        """Drop cached state (after a condition failure — reload next)."""
+        self._ms = None
+
+    @property
+    def condition(self) -> int:
+        return self._condition
+
+    # -- history ------------------------------------------------------
+
+    def branch_token(self, ms: MutableState) -> BranchToken:
+        raw = ms.execution_info.branch_token
+        return BranchToken.from_json(raw.decode())
+
+    def _append_events(
+        self, branch: BranchToken, events: List[HistoryEvent]
+    ) -> int:
+        if not events:
+            return 0
+        return self.shard.persistence.history.append_history_nodes(
+            branch, events, transaction_id=self.shard.next_task_id()
+        )
+
+    # -- persist ------------------------------------------------------
+
+    def _snapshot_of(
+        self, ms: MutableState, result_tasks: TransactionResult,
+        new_run: bool = False,
+    ) -> WorkflowSnapshot:
+        ei = ms.execution_info
+        return WorkflowSnapshot(
+            domain_id=self.domain_id,
+            workflow_id=self.workflow_id,
+            run_id=ei.run_id,
+            snapshot=ms.snapshot(),
+            next_event_id=ms.next_event_id,
+            last_write_version=ms.current_version,
+            transfer_tasks=(
+                result_tasks.new_run_transfer_tasks
+                if new_run
+                else result_tasks.transfer_tasks
+            ),
+            timer_tasks=(
+                result_tasks.new_run_timer_tasks
+                if new_run
+                else result_tasks.timer_tasks
+            ),
+        )
+
+    def create_workflow(
+        self,
+        ms: MutableState,
+        result: TransactionResult,
+        mode: int = CreateWorkflowMode.BRAND_NEW,
+        prev_run_id: str = "",
+    ) -> None:
+        """First persistence of a new run: new branch, events, record."""
+        history = self.shard.persistence.history
+        branch = history.new_history_branch(tree_id=self.run_id)
+        ms.execution_info.branch_token = branch.to_json().encode()
+        size = self._append_events(branch, result.events)
+        ms.execution_info.history_size = size
+        self.shard.assign_task_ids(result.transfer_tasks, result.timer_tasks)
+        self.shard.persistence.execution.create_workflow_execution(
+            self.shard.shard_id,
+            self.shard.range_id,
+            mode,
+            self._snapshot_of(ms, result),
+            prev_run_id=prev_run_id,
+        )
+        self._ms = ms
+        self._condition = ms.next_event_id
+
+    def update_workflow(
+        self, ms: MutableState, result: TransactionResult
+    ) -> None:
+        """Persist a mutation of a loaded workflow (+ CAN run if staged)."""
+        size = 0
+        if result.events:
+            size = self._append_events(self.branch_token(ms), result.events)
+        ms.execution_info.history_size += size
+        self.shard.assign_task_ids(result.transfer_tasks, result.timer_tasks)
+
+        new_snapshot = None
+        if result.new_run_ms is not None:
+            new_ms = result.new_run_ms
+            new_run_id = result.events[-1].attributes.get(
+                "new_execution_run_id", ""
+            )
+            new_ms.execution_info.run_id = new_run_id
+            branch = self.shard.persistence.history.new_history_branch(
+                tree_id=new_run_id
+            )
+            new_ms.execution_info.branch_token = branch.to_json().encode()
+            new_size = self._append_events(branch, result.new_run_events)
+            new_ms.execution_info.history_size = new_size
+            self.shard.assign_task_ids(
+                result.new_run_transfer_tasks, result.new_run_timer_tasks
+            )
+            new_snapshot = self._snapshot_of(new_ms, result, new_run=True)
+
+        self.shard.persistence.execution.update_workflow_execution(
+            self.shard.shard_id,
+            self.shard.range_id,
+            self._condition,
+            self._snapshot_of(ms, result),
+            new_snapshot=new_snapshot,
+        )
+        self._condition = ms.next_event_id
+
+    # -- reads --------------------------------------------------------
+
+    def read_history(
+        self,
+        ms: MutableState,
+        first_event_id: int = 1,
+        next_event_id: int = 0,
+        page_size: int = 0,
+        next_token: int = 0,
+    ) -> Tuple[List[HistoryEvent], int]:
+        branch = self.branch_token(ms)
+        batches, token = self.shard.persistence.history.read_history_branch(
+            branch,
+            first_event_id,
+            next_event_id or ms.next_event_id,
+            page_size=page_size,
+            next_token=next_token,
+        )
+        return [e for batch in batches for e in batch], token

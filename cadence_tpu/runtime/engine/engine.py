@@ -1,0 +1,731 @@
+"""The history engine: all workflow mutations for one shard.
+
+Reference: service/history/historyEngine.go (Start :408, Signal :1493,
+SignalWithStart :1606, Terminate, RequestCancel, RecordDecisionTask
+Started, RespondDecisionTaskCompleted via decisionHandler.go:258-340,
+activity RPCs) — per-workflow lock + optimistic-concurrency retry
+(Update_History_Loop, decisionHandler.go:291-311) around every mutation.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cadence_tpu.core.active_transaction import (
+    ActiveTransaction,
+    TransactionResult,
+    WorkflowStateError,
+)
+from cadence_tpu.core.enums import (
+    CloseStatus,
+    IDReusePolicy,
+    TimeoutType,
+    WorkflowState,
+)
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION, TRANSIENT_EVENT_ID
+from cadence_tpu.core.mutable_state import MutableState
+from cadence_tpu.core.version_history import VersionHistories
+from cadence_tpu.utils.log import get_logger
+from cadence_tpu.utils.metrics import NOOP, Scope
+
+from ..api import (
+    BadRequestError,
+    CancellationAlreadyRequestedError,
+    Decision,
+    DescribeWorkflowResponse,
+    EntityNotExistsServiceError,
+    InternalServiceError,
+    SignalRequest,
+    SignalWithStartRequest,
+    StartWorkflowRequest,
+    WorkflowExecutionAlreadyStartedServiceError,
+    make_task_token,
+)
+from ..domains import DomainCache
+from ..persistence.errors import (
+    ConditionFailedError,
+    EntityNotExistsError,
+    WorkflowAlreadyStartedError,
+)
+from ..persistence.records import CreateWorkflowMode
+from ..shard import ShardContext
+from .cache import HistoryCache
+from .context import WorkflowExecutionContext
+from .decision_handler import DecisionFailure, DecisionTaskHandler
+
+_CONDITION_RETRY_COUNT = 5  # reference: workflowExecutionContext conditionalRetryCount
+
+
+class HistoryEngine:
+    def __init__(
+        self,
+        shard: ShardContext,
+        domain_cache: DomainCache,
+        metrics: Scope = NOOP,
+        task_notifier: Optional[Callable[[], None]] = None,
+        timer_notifier: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.shard = shard
+        self.domains = domain_cache
+        self.metrics = metrics.tagged(service="history", shard=str(shard.shard_id))
+        self.log = get_logger("cadence_tpu.history", shard=shard.shard_id)
+        self.cache = HistoryCache(
+            lambda d, w, r: WorkflowExecutionContext(shard, d, w, r)
+        )
+        # queue processors poke these after each persisted transaction
+        self._task_notifier = task_notifier or (lambda: None)
+        self._timer_notifier = timer_notifier or (lambda: None)
+
+    # -- helpers ------------------------------------------------------
+
+    def _domain_version(self, domain_record) -> int:
+        return (
+            domain_record.failover_version
+            if domain_record.is_global
+            else EMPTY_VERSION
+        )
+
+    def _notify(self, result: TransactionResult) -> None:
+        if result.transfer_tasks or result.new_run_transfer_tasks:
+            self._task_notifier()
+        if result.timer_tasks or result.new_run_timer_tasks:
+            self._timer_notifier()
+
+    def _current_run_id(self, domain_id: str, workflow_id: str) -> str:
+        try:
+            return self.shard.persistence.execution.get_current_execution(
+                self.shard.shard_id, domain_id, workflow_id
+            ).run_id
+        except EntityNotExistsError:
+            raise EntityNotExistsServiceError(
+                f"workflow {workflow_id} not found"
+            )
+
+    def _update_workflow(
+        self,
+        domain_id: str,
+        workflow_id: str,
+        run_id: str,
+        action: Callable[[WorkflowExecutionContext, MutableState], Any],
+    ) -> Any:
+        """The Update_History_Loop: lock, load, act, persist; reload and
+        retry on optimistic-concurrency failure."""
+        if not run_id:
+            run_id = self._current_run_id(domain_id, workflow_id)
+        ctx = self.cache.get_or_create(domain_id, workflow_id, run_id)
+        with ctx.lock:
+            for _ in range(_CONDITION_RETRY_COUNT):
+                try:
+                    ms = ctx.load()
+                except EntityNotExistsError:
+                    raise EntityNotExistsServiceError(
+                        f"workflow {workflow_id}/{run_id} not found"
+                    )
+                try:
+                    return action(ctx, ms)
+                except ConditionFailedError:
+                    ctx.clear()
+                    continue
+            raise InternalServiceError(
+                f"workflow {workflow_id} update failed after "
+                f"{_CONDITION_RETRY_COUNT} condition retries"
+            )
+
+    def _txn(
+        self, ctx: WorkflowExecutionContext, ms: MutableState,
+        version: int, request_id: str = "",
+    ) -> ActiveTransaction:
+        return ActiveTransaction(
+            ms, ctx.domain_id, ctx.workflow_id, ctx.run_id, version,
+            request_id=request_id,
+            domain_resolver=lambda name: (
+                self.domains.get_by_name(name).info.id if name else ""
+            ),
+        )
+
+    # -- StartWorkflowExecution ---------------------------------------
+
+    def start_workflow_execution(
+        self, request: StartWorkflowRequest, domain_id: str = "",
+        signal_name: str = "", signal_input: bytes = b"",
+        prev_started_check: bool = True,
+    ) -> str:
+        """Returns the new run_id (reference historyEngine.go:408)."""
+        request.validate()
+        domain = (
+            self.domains.get_by_id(domain_id)
+            if domain_id
+            else self.domains.get_by_name(request.domain)
+        )
+        domain_id = domain.info.id
+        run_id = str(uuid.uuid4())
+        request_id = request.request_id or str(uuid.uuid4())
+        version = self._domain_version(domain)
+        now = self.shard.now()
+
+        ms = MutableState(domain_id=domain_id, current_version=version)
+        if domain.is_global:
+            ms.version_histories = VersionHistories.new_empty()
+        txn = ActiveTransaction(
+            ms, domain_id, request.workflow_id, run_id, version,
+            request_id=request_id,
+        )
+        txn.add_workflow_execution_started(
+            now,
+            workflow_type=request.workflow_type,
+            task_list=request.task_list,
+            execution_start_to_close_timeout_seconds=(
+                request.execution_start_to_close_timeout_seconds
+            ),
+            task_start_to_close_timeout_seconds=(
+                request.task_start_to_close_timeout_seconds
+            ),
+            input=request.input,
+            identity=request.identity,
+            retry_policy=request.retry_policy,
+            cron_schedule=request.cron_schedule,
+            memo=request.memo,
+            search_attributes=request.search_attributes,
+        )
+        if signal_name:
+            txn.add_workflow_execution_signaled(
+                signal_name, signal_input, request.identity, now
+            )
+        txn.add_decision_task_scheduled(now)
+        result = txn.close()
+
+        ctx = self.cache.get_or_create(domain_id, request.workflow_id, run_id)
+        with ctx.lock:
+            try:
+                ctx.create_workflow(ms, result)
+            except WorkflowAlreadyStartedError as e:
+                return self._handle_start_collision(
+                    request, domain_id, ms, result, ctx, e, request_id
+                )
+        self._notify(result)
+        self.metrics.inc("workflow_started")
+        return run_id
+
+    def _handle_start_collision(
+        self, request, domain_id, ms, result, ctx, err, request_id
+    ) -> str:
+        # request-id dedup: same start request -> same run (reference
+        # historyEngine.go startWorkflow dedup on CreateRequestID)
+        if err.start_request_id == request_id:
+            return err.run_id
+        policy = request.workflow_id_reuse_policy
+        if err.state != int(WorkflowState.Completed):
+            raise WorkflowExecutionAlreadyStartedServiceError(
+                f"workflow {request.workflow_id} already running",
+                err.start_request_id, err.run_id,
+            )
+        if policy == IDReusePolicy.RejectDuplicate:
+            raise WorkflowExecutionAlreadyStartedServiceError(
+                f"workflow {request.workflow_id} already finished "
+                "(RejectDuplicate)",
+                err.start_request_id, err.run_id,
+            )
+        if (
+            policy == IDReusePolicy.AllowDuplicateFailedOnly
+            and err.close_status
+            in (int(CloseStatus.Completed), int(CloseStatus.ContinuedAsNew))
+        ):
+            raise WorkflowExecutionAlreadyStartedServiceError(
+                f"workflow {request.workflow_id} completed successfully "
+                "(AllowDuplicateFailedOnly)",
+                err.start_request_id, err.run_id,
+            )
+        ctx.create_workflow(
+            ms, result,
+            mode=CreateWorkflowMode.WORKFLOW_ID_REUSE,
+            prev_run_id=err.run_id,
+        )
+        self._notify(result)
+        return ms.execution_info.run_id
+
+    # -- signals ------------------------------------------------------
+
+    def signal_workflow_execution(self, request: SignalRequest) -> None:
+        request.validate()
+        domain = self.domains.get_by_name(request.domain)
+        version = self._domain_version(domain)
+
+        def action(ctx, ms):
+            if request.request_id and request.request_id in ms.signal_requested_ids:
+                return  # dedup
+            txn = self._txn(ctx, ms, version)
+            try:
+                txn.add_workflow_execution_signaled(
+                    request.signal_name, request.input, request.identity,
+                    self.shard.now(),
+                )
+                if not ms.has_pending_decision() and not txn.has_buffered_events():
+                    txn.add_decision_task_scheduled(self.shard.now())
+            except WorkflowStateError as e:
+                raise EntityNotExistsServiceError(str(e))
+            if request.request_id:
+                ms.signal_requested_ids.add(request.request_id)
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(
+            domain.info.id, request.workflow_id, request.run_id, action
+        )
+
+    def signal_with_start_workflow_execution(
+        self, request: SignalWithStartRequest
+    ) -> str:
+        request.validate()
+        start = request.start
+        domain = self.domains.get_by_name(start.domain)
+        # running workflow -> plain signal (reference historyEngine.go:1606)
+        try:
+            run_id = self._current_run_id(domain.info.id, start.workflow_id)
+            cur = self.shard.persistence.execution.get_current_execution(
+                self.shard.shard_id, domain.info.id, start.workflow_id
+            )
+            if cur.state != int(WorkflowState.Completed):
+                self.signal_workflow_execution(
+                    SignalRequest(
+                        domain=start.domain,
+                        workflow_id=start.workflow_id,
+                        run_id=run_id,
+                        signal_name=request.signal_name,
+                        input=request.signal_input,
+                        identity=start.identity,
+                    )
+                )
+                return run_id
+        except (EntityNotExistsServiceError, EntityNotExistsError):
+            pass
+        return self.start_workflow_execution(
+            start,
+            domain_id=domain.info.id,
+            signal_name=request.signal_name,
+            signal_input=request.signal_input,
+        )
+
+    # -- terminate / cancel -------------------------------------------
+
+    def terminate_workflow_execution(
+        self, domain_name: str, workflow_id: str, run_id: str = "",
+        reason: str = "", details: bytes = b"", identity: str = "",
+    ) -> None:
+        domain = self.domains.get_by_name(domain_name)
+        version = self._domain_version(domain)
+
+        def action(ctx, ms):
+            txn = self._txn(ctx, ms, version)
+            try:
+                txn.add_workflow_execution_terminated(
+                    self.shard.now(), reason=reason, details=details,
+                    identity=identity,
+                )
+            except WorkflowStateError as e:
+                raise EntityNotExistsServiceError(str(e))
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(domain.info.id, workflow_id, run_id, action)
+
+    def request_cancel_workflow_execution(
+        self, domain_name: str, workflow_id: str, run_id: str = "",
+        cause: str = "", identity: str = "",
+    ) -> None:
+        domain = self.domains.get_by_name(domain_name)
+        version = self._domain_version(domain)
+
+        def action(ctx, ms):
+            txn = self._txn(ctx, ms, version)
+            try:
+                txn.add_workflow_execution_cancel_requested(
+                    cause, identity, self.shard.now()
+                )
+                if not ms.has_pending_decision():
+                    txn.add_decision_task_scheduled(self.shard.now())
+            except WorkflowStateError as e:
+                if ms.execution_info.cancel_requested:
+                    raise CancellationAlreadyRequestedError(str(e))
+                raise EntityNotExistsServiceError(str(e))
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(domain.info.id, workflow_id, run_id, action)
+
+    # -- decision task lifecycle --------------------------------------
+
+    def record_decision_task_started(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        schedule_id: int, request_id: str, identity: str = "",
+    ) -> Dict[str, Any]:
+        """Called by matching on dispatch; returns poll-response fields
+        (reference decisionHandler.handleDecisionTaskStarted)."""
+
+        def action(ctx, ms):
+            ei = ms.execution_info
+            if not ms.has_pending_decision() or ei.decision_schedule_id != schedule_id:
+                # stale dispatch: decision already handled
+                raise EntityNotExistsServiceError(
+                    f"decision {schedule_id} not found "
+                    f"(current {ei.decision_schedule_id})"
+                )
+            if ei.decision_started_id != EMPTY_EVENT_ID:
+                if ei.decision_request_id == request_id:
+                    pass  # duplicate dispatch of same poll: return same
+                else:
+                    raise EntityNotExistsServiceError(
+                        f"decision {schedule_id} already started"
+                    )
+            version = ms.current_version
+            txn = self._txn(ctx, ms, version)
+            if ei.decision_started_id == EMPTY_EVENT_ID:
+                try:
+                    txn.add_decision_task_started(
+                        schedule_id, request_id, identity, self.shard.now()
+                    )
+                except WorkflowStateError as e:
+                    raise EntityNotExistsServiceError(str(e))
+                result = txn.close()
+                ctx.update_workflow(ms, result)
+                self._notify(result)
+            history, _ = ctx.read_history(ms)
+            return {
+                "workflow_type": ms.execution_info.workflow_type_name,
+                "previous_started_event_id": ms.execution_info.last_processed_event,
+                "scheduled_event_id": ms.execution_info.decision_schedule_id,
+                "started_event_id": ms.execution_info.decision_started_id,
+                "attempt": ms.execution_info.decision_attempt,
+                "history": history,
+                "task_token": make_task_token(
+                    domain_id, workflow_id, run_id,
+                    ms.execution_info.decision_schedule_id,
+                    ms.execution_info.decision_started_id,
+                ),
+            }
+
+        return self._update_workflow(domain_id, workflow_id, run_id, action)
+
+    def respond_decision_task_completed(
+        self,
+        task_token: Dict[str, Any],
+        decisions: List[Decision],
+        identity: str = "",
+        binary_checksum: str = "",
+        sticky_task_list: str = "",
+        sticky_schedule_to_start_timeout_seconds: int = 0,
+    ) -> None:
+        domain_id = task_token["domain_id"]
+        workflow_id = task_token["workflow_id"]
+        run_id = task_token["run_id"]
+        schedule_id = task_token["schedule_id"]
+
+        def action(ctx, ms):
+            ei = ms.execution_info
+            if (
+                ei.decision_schedule_id != schedule_id
+                or ei.decision_started_id == EMPTY_EVENT_ID
+            ):
+                raise EntityNotExistsServiceError(
+                    f"decision {schedule_id} not in flight"
+                )
+            started_id = ei.decision_started_id
+            version = ms.current_version
+            now = self.shard.now()
+            txn = self._txn(ctx, ms, version)
+            had_buffered = txn.has_buffered_events()
+            completed = txn.add_decision_task_completed(
+                schedule_id, started_id, now,
+                identity=identity, binary_checksum=binary_checksum,
+            )
+            # stickiness (reference: handleDecisionTaskCompleted)
+            if sticky_task_list:
+                ei.sticky_task_list = sticky_task_list
+                ei.sticky_schedule_to_start_timeout = (
+                    sticky_schedule_to_start_timeout_seconds
+                )
+            else:
+                ms.clear_stickiness()
+
+            handler = DecisionTaskHandler(
+                txn, completed.event_id, now, identity=identity,
+                had_buffered_events=had_buffered,
+            )
+            try:
+                handler.handle(decisions)
+            except DecisionFailure as failure:
+                # reset and fail the decision task instead
+                # (reference decisionTaskHandler failDecision path)
+                ctx.clear()
+                self._fail_decision_task(
+                    ctx, schedule_id, failure.cause, str(failure), identity
+                )
+                return
+            # events needing a fresh decision: flushed buffered events or
+            # a dropped close
+            if not handler.workflow_closed and (
+                handler.unhandled_close_dropped
+                or self._needs_new_decision(txn, completed.event_id)
+            ):
+                txn.add_decision_task_scheduled(now)
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(domain_id, workflow_id, run_id, action)
+
+    @staticmethod
+    def _needs_new_decision(txn, completed_id: int) -> bool:
+        """Flushed buffered events after the completion require a new
+        decision so the worker sees them."""
+        from cadence_tpu.core.active_transaction import _BUFFERABLE
+
+        return any(
+            e.event_id > completed_id and e.event_type in _BUFFERABLE
+            for e in txn.batch
+        )
+
+    def _fail_decision_task(
+        self, ctx, schedule_id: int, cause: int, message: str, identity: str
+    ) -> None:
+        ms = ctx.load()
+        ei = ms.execution_info
+        if ei.decision_schedule_id != schedule_id:
+            return
+        txn = self._txn(ctx, ms, ms.current_version)
+        txn.add_decision_task_failed(
+            schedule_id, ei.decision_started_id, self.shard.now(),
+            cause=cause, identity=identity, details=message.encode(),
+        )
+        result = txn.close()
+        ctx.update_workflow(ms, result)
+        self._notify(result)
+
+    def respond_decision_task_failed(
+        self, task_token: Dict[str, Any], cause: int = 0,
+        details: bytes = b"", identity: str = "",
+    ) -> None:
+        def action(ctx, ms):
+            ei = ms.execution_info
+            if (
+                ei.decision_schedule_id != task_token["schedule_id"]
+                or ei.decision_started_id == EMPTY_EVENT_ID
+            ):
+                raise EntityNotExistsServiceError("decision not in flight")
+            txn = self._txn(ctx, ms, ms.current_version)
+            txn.add_decision_task_failed(
+                ei.decision_schedule_id, ei.decision_started_id,
+                self.shard.now(), cause=cause, identity=identity,
+                details=details,
+            )
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(
+            task_token["domain_id"], task_token["workflow_id"],
+            task_token["run_id"], action,
+        )
+
+    # -- activity task lifecycle --------------------------------------
+
+    def record_activity_task_started(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        schedule_id: int, request_id: str, identity: str = "",
+    ) -> Dict[str, Any]:
+        def action(ctx, ms):
+            ai = ms.get_activity_info(schedule_id)
+            if ai is None:
+                raise EntityNotExistsServiceError(
+                    f"activity {schedule_id} not pending"
+                )
+            if ai.started_id != EMPTY_EVENT_ID:
+                if ai.request_id == request_id:
+                    pass  # duplicate dispatch
+                else:
+                    raise EntityNotExistsServiceError(
+                        f"activity {schedule_id} already started"
+                    )
+            else:
+                txn = self._txn(ctx, ms, ms.current_version)
+                txn.record_activity_task_started(
+                    ai, request_id, identity, self.shard.now()
+                )
+                result = txn.close()
+                ctx.update_workflow(ms, result)
+            # the poll response needs the scheduled event's payload; the
+            # events cache only helps within one process lifetime, so fall
+            # back to the history branch
+            scheduled_event = next(
+                (e for e in ms.cached_events if e.event_id == schedule_id),
+                None,
+            )
+            if scheduled_event is None:
+                history, _ = ctx.read_history(ms)
+                scheduled_event = next(
+                    (e for e in history if e.event_id == schedule_id), None
+                )
+            return {
+                "activity_id": ai.activity_id,
+                "scheduled_time": ai.scheduled_time,
+                "started_time": ai.started_time,
+                "attempt": ai.attempt,
+                "heartbeat_details": ai.details,
+                "schedule_to_close_timeout_seconds": ai.schedule_to_close_timeout,
+                "start_to_close_timeout_seconds": ai.start_to_close_timeout,
+                "heartbeat_timeout_seconds": ai.heartbeat_timeout,
+                "scheduled_event": scheduled_event,
+                "task_token": make_task_token(
+                    domain_id, workflow_id, run_id, schedule_id,
+                    activity_id=ai.activity_id,
+                ),
+            }
+
+        return self._update_workflow(domain_id, workflow_id, run_id, action)
+
+    def _respond_activity(
+        self, task_token: Dict[str, Any],
+        add: Callable[[ActiveTransaction, int, int], None],
+    ) -> None:
+        schedule_id = task_token["schedule_id"]
+
+        def action(ctx, ms):
+            txn = self._txn(ctx, ms, ms.current_version)
+            now = self.shard.now()
+            try:
+                add(txn, schedule_id, now)
+                if not ms.has_pending_decision() and not txn.has_buffered_events():
+                    txn.add_decision_task_scheduled(now)
+            except WorkflowStateError as e:
+                raise EntityNotExistsServiceError(str(e))
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(
+            task_token["domain_id"], task_token["workflow_id"],
+            task_token["run_id"], action,
+        )
+
+    def respond_activity_task_completed(
+        self, task_token: Dict[str, Any], result: bytes = b"",
+        identity: str = "",
+    ) -> None:
+        self._respond_activity(
+            task_token,
+            lambda txn, sid, now: txn.add_activity_task_completed(
+                sid, now, result=result, identity=identity
+            ),
+        )
+
+    def respond_activity_task_failed(
+        self, task_token: Dict[str, Any], reason: str = "",
+        details: bytes = b"", identity: str = "",
+    ) -> None:
+        self._respond_activity(
+            task_token,
+            lambda txn, sid, now: txn.add_activity_task_failed(
+                sid, now, reason=reason, details=details, identity=identity
+            ),
+        )
+
+    def respond_activity_task_canceled(
+        self, task_token: Dict[str, Any], details: bytes = b"",
+        identity: str = "",
+    ) -> None:
+        self._respond_activity(
+            task_token,
+            lambda txn, sid, now: txn.add_activity_task_canceled(
+                sid, EMPTY_EVENT_ID, now, details=details, identity=identity
+            ),
+        )
+
+    def record_activity_task_heartbeat(
+        self, task_token: Dict[str, Any], details: bytes = b"",
+        identity: str = "",
+    ) -> bool:
+        """Returns cancel_requested (reference historyEngine
+        RecordActivityTaskHeartbeat — state-only update, no event)."""
+        schedule_id = task_token["schedule_id"]
+
+        def action(ctx, ms):
+            ai = ms.get_activity_info(schedule_id)
+            if ai is None:
+                raise EntityNotExistsServiceError(
+                    f"activity {schedule_id} not pending"
+                )
+            ai.details = details
+            ai.last_heartbeat_updated_time = self.shard.now()
+            result = TransactionResult(
+                events=[], transfer_tasks=[], timer_tasks=[]
+            )
+            ctx.update_workflow(ms, result)
+            return ai.cancel_requested
+
+        return self._update_workflow(
+            task_token["domain_id"], task_token["workflow_id"],
+            task_token["run_id"], action,
+        )
+
+    # -- reads --------------------------------------------------------
+
+    def get_workflow_execution_history(
+        self, domain_name: str, workflow_id: str, run_id: str = "",
+        first_event_id: int = 1, page_size: int = 0, next_token: int = 0,
+    ) -> Tuple[List[HistoryEvent], int]:
+        domain_id = self.domains.get_by_name(domain_name).info.id
+
+        def action(ctx, ms):
+            return ctx.read_history(
+                ms, first_event_id=first_event_id, page_size=page_size,
+                next_token=next_token,
+            )
+
+        return self._update_workflow(domain_id, workflow_id, run_id, action)
+
+    def describe_workflow_execution(
+        self, domain_name: str, workflow_id: str, run_id: str = ""
+    ) -> DescribeWorkflowResponse:
+        domain_id = self.domains.get_by_name(domain_name).info.id
+
+        def action(ctx, ms):
+            ei = ms.execution_info
+            return DescribeWorkflowResponse(
+                workflow_id=ei.workflow_id,
+                run_id=ei.run_id,
+                workflow_type=ei.workflow_type_name,
+                start_time=ei.start_timestamp,
+                close_time=0,
+                close_status=int(ei.close_status),
+                is_running=ms.is_workflow_execution_running(),
+                history_length=ms.next_event_id - 1,
+                pending_activities=[
+                    {
+                        "schedule_id": sid,
+                        "activity_id": ai.activity_id,
+                        "state": (
+                            "STARTED"
+                            if ai.started_id != EMPTY_EVENT_ID
+                            else "SCHEDULED"
+                        ),
+                        "attempt": ai.attempt,
+                    }
+                    for sid, ai in sorted(ms.pending_activities.items())
+                ],
+                pending_children=[
+                    {
+                        "initiated_id": cid,
+                        "workflow_id": ci.started_workflow_id,
+                        "run_id": ci.started_run_id,
+                    }
+                    for cid, ci in sorted(ms.pending_children.items())
+                ],
+                search_attributes=dict(ei.search_attributes),
+                memo=dict(ei.memo),
+            )
+
+        return self._update_workflow(domain_id, workflow_id, run_id, action)

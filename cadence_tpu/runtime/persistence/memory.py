@@ -116,8 +116,13 @@ class MemoryExecutionManager(I.ExecutionManager):
         self._put_tasks(shard_id, snap)
 
     def _exec_state(self, snapshot: Dict[str, Any]) -> Tuple[int, int]:
-        ex = snapshot.get("exec", snapshot)
+        ex = snapshot.get("execution_info") or snapshot.get("exec") or snapshot
         return int(ex.get("state", 0)), int(ex.get("close_status", 0))
+
+    @staticmethod
+    def _request_id(snapshot: Dict[str, Any]) -> str:
+        ex = snapshot.get("execution_info") or {}
+        return ex.get("create_request_id") or snapshot.get("request_id", "")
 
     # -- executions ---------------------------------------------------
 
@@ -169,7 +174,7 @@ class MemoryExecutionManager(I.ExecutionManager):
             if mode != CreateWorkflowMode.ZOMBIE:
                 self._current[cur_key] = CurrentExecution(
                     run_id=snapshot.run_id,
-                    create_request_id=snapshot.snapshot.get("request_id", ""),
+                    create_request_id=self._request_id(snapshot.snapshot),
                     state=state,
                     close_status=close_status,
                     last_write_version=snapshot.last_write_version,

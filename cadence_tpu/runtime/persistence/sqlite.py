@@ -213,8 +213,13 @@ class SqliteExecutionManager(I.ExecutionManager):
 
     @staticmethod
     def _exec_state(snapshot: Dict[str, Any]) -> Tuple[int, int]:
-        ex = snapshot.get("exec", snapshot)
+        ex = snapshot.get("execution_info") or snapshot.get("exec") or snapshot
         return int(ex.get("state", 0)), int(ex.get("close_status", 0))
+
+    @staticmethod
+    def _request_id(snapshot: Dict[str, Any]) -> str:
+        ex = snapshot.get("execution_info") or {}
+        return ex.get("create_request_id") or snapshot.get("request_id", "")
 
     def _create_locked(
         self, c, shard_id, range_id, mode, snapshot, prev_run_id,
@@ -260,7 +265,7 @@ class SqliteExecutionManager(I.ExecutionManager):
                 (
                     shard_id, snapshot.domain_id, snapshot.workflow_id,
                     snapshot.run_id,
-                    snapshot.snapshot.get("request_id", ""),
+                    self._request_id(snapshot.snapshot),
                     state, close_status, snapshot.last_write_version,
                 ),
             )

@@ -1,0 +1,123 @@
+"""Shard context: per-shard sequencing, ack levels, range fencing.
+
+Reference: service/history/shardContext.go — every history-shard write
+carries the shard's range_id; task IDs are allocated monotonically from
+range-scoped blocks so a stolen shard can never mint colliding or
+regressing IDs (taskID = range_id << 24 | seq, renewing the lease when a
+block exhausts, mirroring the reference's transferSequenceNumber block
+scheme)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from cadence_tpu.utils.clock import RealTimeSource, TimeSource
+
+from .persistence.errors import EntityNotExistsError
+from .persistence.interfaces import PersistenceBundle
+from .persistence.records import ShardInfo
+
+BLOCK_BITS = 24
+BLOCK_SIZE = 1 << BLOCK_BITS
+
+
+class ShardContext:
+    def __init__(
+        self,
+        shard_id: int,
+        persistence: PersistenceBundle,
+        owner: str = "",
+        time_source: Optional[TimeSource] = None,
+    ) -> None:
+        self.shard_id = shard_id
+        self.persistence = persistence
+        self.owner = owner
+        self.time_source = time_source or RealTimeSource()
+        self._lock = threading.RLock()
+        self._info = self._acquire()
+        self._next_task_seq = 0
+
+    # -- lease --------------------------------------------------------
+
+    def _acquire(self) -> ShardInfo:
+        try:
+            info = self.persistence.shard.get_shard(self.shard_id)
+        except EntityNotExistsError:
+            info = ShardInfo(shard_id=self.shard_id, range_id=0)
+            self.persistence.shard.create_shard(info)
+        prev = info.range_id
+        info.range_id += 1
+        info.owner = self.owner
+        self.persistence.shard.update_shard(info, previous_range_id=prev)
+        return info
+
+    @property
+    def range_id(self) -> int:
+        with self._lock:
+            return self._info.range_id
+
+    def renew_range(self) -> None:
+        """Bump the lease (new task-ID block; fences older owners)."""
+        with self._lock:
+            prev = self._info.range_id
+            self._info.range_id += 1
+            self.persistence.shard.update_shard(
+                self._info, previous_range_id=prev
+            )
+            self._next_task_seq = 0
+
+    # -- task id sequencing -------------------------------------------
+
+    def next_task_id(self) -> int:
+        with self._lock:
+            if self._next_task_seq >= BLOCK_SIZE:
+                self.renew_range()
+            tid = (self._info.range_id << BLOCK_BITS) | self._next_task_seq
+            self._next_task_seq += 1
+            return tid
+
+    def assign_task_ids(self, *task_lists) -> None:
+        """Stamp task_id on every task in the given lists."""
+        for tasks in task_lists:
+            for t in tasks:
+                t.task_id = self.next_task_id()
+
+    # -- ack levels ---------------------------------------------------
+
+    def _update(self) -> None:
+        self.persistence.shard.update_shard(
+            self._info, previous_range_id=self._info.range_id
+        )
+
+    def get_transfer_ack_level(self) -> int:
+        with self._lock:
+            return self._info.transfer_ack_level
+
+    def update_transfer_ack_level(self, level: int) -> None:
+        with self._lock:
+            self._info.transfer_ack_level = level
+            self._update()
+
+    def get_timer_ack_level(self) -> int:
+        with self._lock:
+            return self._info.timer_ack_level
+
+    def update_timer_ack_level(self, level: int) -> None:
+        with self._lock:
+            self._info.timer_ack_level = level
+            self._update()
+
+    def get_replication_ack_level(self) -> int:
+        with self._lock:
+            return self._info.replication_ack_level
+
+    def update_replication_ack_level(self, level: int) -> None:
+        with self._lock:
+            self._info.replication_ack_level = level
+            self._update()
+
+    # -- time ---------------------------------------------------------
+
+    def now(self) -> int:
+        return self.time_source.now()

@@ -1,0 +1,410 @@
+"""History engine: workflow lifecycle RPCs against real (memory)
+persistence — start, decision round-trips, activities, signals,
+terminate/cancel, continue-as-new, ID reuse, describe/history reads."""
+
+import pytest
+
+from cadence_tpu.core.enums import (
+    CloseStatus,
+    DecisionType,
+    EventType,
+    IDReusePolicy,
+)
+from cadence_tpu.runtime.api import (
+    BadRequestError,
+    CancellationAlreadyRequestedError,
+    Decision,
+    EntityNotExistsServiceError,
+    SignalRequest,
+    SignalWithStartRequest,
+    StartWorkflowRequest,
+    WorkflowExecutionAlreadyStartedServiceError,
+)
+from cadence_tpu.runtime.domains import DomainCache, register_domain
+from cadence_tpu.runtime.engine import HistoryEngine
+from cadence_tpu.runtime.persistence import create_memory_bundle
+from cadence_tpu.runtime.shard import ShardContext
+from cadence_tpu.utils.clock import SECOND, FakeTimeSource
+
+
+@pytest.fixture
+def env():
+    bundle = create_memory_bundle()
+    clock = FakeTimeSource()
+    shard = ShardContext(1, bundle, owner="host1", time_source=clock)
+    register_domain(bundle.metadata, "dom", retention_days=1)
+    engine = HistoryEngine(shard, DomainCache(bundle.metadata))
+    return bundle, clock, engine
+
+
+def start_req(wf="wf1", **kw):
+    defaults = dict(
+        domain="dom", workflow_id=wf, workflow_type="echo", task_list="tl",
+        execution_start_to_close_timeout_seconds=3600,
+        task_start_to_close_timeout_seconds=10,
+    )
+    defaults.update(kw)
+    return StartWorkflowRequest(**defaults)
+
+
+def domain_id(engine):
+    return engine.domains.get_by_name("dom").info.id
+
+
+def poll_decision(engine, run_id, wf="wf1", req="poll-1"):
+    d_id = domain_id(engine)
+    # find schedule id from current state
+    resp = engine.shard.persistence.execution.get_workflow_execution(
+        1, d_id, wf, run_id
+    )
+    sched = resp.snapshot["execution_info"]["decision_schedule_id"]
+    return engine.record_decision_task_started(
+        d_id, wf, run_id, sched, req, identity="worker"
+    )
+
+
+def test_start_validation(env):
+    _, _, engine = env
+    with pytest.raises(BadRequestError):
+        engine.start_workflow_execution(start_req(workflow_id=""))
+    with pytest.raises(BadRequestError):
+        engine.start_workflow_execution(
+            start_req(execution_start_to_close_timeout_seconds=0)
+        )
+
+
+def test_echo_workflow_end_to_end(env):
+    bundle, clock, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    assert run_id
+
+    # decision 1: schedule activity
+    task = poll_decision(engine, run_id)
+    assert task["workflow_type"] == "echo"
+    assert [e.event_type for e in task["history"]] == [
+        EventType.WorkflowExecutionStarted,
+        EventType.DecisionTaskScheduled,
+        EventType.DecisionTaskStarted,
+    ]
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [
+            Decision(
+                DecisionType.ScheduleActivityTask,
+                {
+                    "activity_id": "a1",
+                    "activity_type": "echo-act",
+                    "input": b"ping",
+                    "schedule_to_close_timeout_seconds": 60,
+                },
+            )
+        ],
+    )
+
+    # activity round trip
+    d_id = domain_id(engine)
+    resp = bundle.execution.get_workflow_execution(1, d_id, "wf1", run_id)
+    acts = resp.snapshot["pending_activities"]
+    schedule_id = int(next(iter(acts)))
+    atask = engine.record_activity_task_started(
+        d_id, "wf1", run_id, schedule_id, "a-poll-1", identity="worker"
+    )
+    assert atask["activity_id"] == "a1"
+    assert atask["scheduled_event"].attributes["input"] == b"ping"
+    engine.respond_activity_task_completed(
+        atask["task_token"], result=b"pong"
+    )
+
+    # decision 2: complete workflow
+    task = poll_decision(engine, run_id, req="poll-2")
+    types = [e.event_type for e in task["history"]]
+    assert EventType.ActivityTaskCompleted in types
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [
+            Decision(
+                DecisionType.CompleteWorkflowExecution, {"result": b"pong"}
+            )
+        ],
+    )
+
+    desc = engine.describe_workflow_execution("dom", "wf1", run_id)
+    assert not desc.is_running
+    assert desc.close_status == int(CloseStatus.Completed)
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    assert history[-1].event_type == EventType.WorkflowExecutionCompleted
+    # event ids are dense 1..N
+    assert [e.event_id for e in history] == list(range(1, len(history) + 1))
+
+
+def test_signal_schedules_decision(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    # consume first decision
+    task = poll_decision(engine, run_id)
+    engine.respond_decision_task_completed(task["task_token"], [])
+    engine.signal_workflow_execution(
+        SignalRequest(
+            domain="dom", workflow_id="wf1", signal_name="go", input=b"x"
+        )
+    )
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    assert [e.event_type for e in history[-2:]] == [
+        EventType.WorkflowExecutionSignaled,
+        EventType.DecisionTaskScheduled,
+    ]
+    # signal dedup by request id
+    for _ in range(2):
+        engine.signal_workflow_execution(
+            SignalRequest(
+                domain="dom", workflow_id="wf1", signal_name="go",
+                input=b"x", request_id="dedup-1",
+            )
+        )
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    assert (
+        sum(
+            1
+            for e in history
+            if e.event_type == EventType.WorkflowExecutionSignaled
+        )
+        == 2
+    )
+
+
+def test_signal_buffered_during_decision(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    # signal while decision in flight: buffered
+    engine.signal_workflow_execution(
+        SignalRequest(domain="dom", workflow_id="wf1", signal_name="mid")
+    )
+    engine.respond_decision_task_completed(task["task_token"], [])
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    types = [e.event_type for e in history]
+    # signal flushed after completion, then a new decision scheduled for it
+    idx = types.index(EventType.DecisionTaskCompleted)
+    assert types[idx + 1] == EventType.WorkflowExecutionSignaled
+    assert types[idx + 2] == EventType.DecisionTaskScheduled
+
+
+def test_unhandled_signal_drops_close_decision(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    engine.signal_workflow_execution(
+        SignalRequest(domain="dom", workflow_id="wf1", signal_name="mid")
+    )
+    # worker tries to close, but a buffered signal exists -> close dropped
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [Decision(DecisionType.CompleteWorkflowExecution, {})],
+    )
+    desc = engine.describe_workflow_execution("dom", "wf1", run_id)
+    assert desc.is_running
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    assert history[-1].event_type == EventType.DecisionTaskScheduled
+
+
+def test_terminate(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    engine.terminate_workflow_execution("dom", "wf1", reason="ops")
+    desc = engine.describe_workflow_execution("dom", "wf1", run_id)
+    assert desc.close_status == int(CloseStatus.Terminated)
+    with pytest.raises(EntityNotExistsServiceError):
+        engine.terminate_workflow_execution("dom", "wf1", reason="again")
+
+
+def test_cancel_flow(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    engine.respond_decision_task_completed(task["task_token"], [])
+    engine.request_cancel_workflow_execution("dom", "wf1", cause="user")
+    with pytest.raises(CancellationAlreadyRequestedError):
+        engine.request_cancel_workflow_execution("dom", "wf1", cause="user")
+    # worker sees cancel request, cancels
+    task = poll_decision(engine, run_id, req="poll-2")
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [Decision(DecisionType.CancelWorkflowExecution, {})],
+    )
+    desc = engine.describe_workflow_execution("dom", "wf1", run_id)
+    assert desc.close_status == int(CloseStatus.Canceled)
+
+
+def test_decision_failure_bad_attributes(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    # missing activity_id -> decision task failed, workflow still running
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [Decision(DecisionType.ScheduleActivityTask, {"activity_type": "t"})],
+    )
+    desc = engine.describe_workflow_execution("dom", "wf1", run_id)
+    assert desc.is_running
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    assert history[-1].event_type == EventType.DecisionTaskFailed
+    # transient retry decision pending in state
+    resp = engine.shard.persistence.execution.get_workflow_execution(
+        1, domain_id(engine), "wf1", run_id
+    )
+    assert resp.snapshot["execution_info"]["decision_attempt"] == 1
+
+
+def test_workflow_id_reuse(env):
+    _, _, engine = env
+    run1 = engine.start_workflow_execution(start_req())
+    # same id while running -> rejected
+    with pytest.raises(WorkflowExecutionAlreadyStartedServiceError):
+        engine.start_workflow_execution(start_req())
+    engine.terminate_workflow_execution("dom", "wf1")
+    # terminated (not completed) + AllowDuplicateFailedOnly -> allowed
+    run2 = engine.start_workflow_execution(start_req())
+    assert run2 != run1
+    # complete run2 via decision
+    task = poll_decision(engine, run2, req="p")
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [Decision(DecisionType.CompleteWorkflowExecution, {})],
+    )
+    # completed + AllowDuplicateFailedOnly -> rejected
+    with pytest.raises(WorkflowExecutionAlreadyStartedServiceError):
+        engine.start_workflow_execution(start_req())
+    # AllowDuplicate -> allowed
+    run3 = engine.start_workflow_execution(
+        start_req(workflow_id_reuse_policy=IDReusePolicy.AllowDuplicate)
+    )
+    assert run3 not in (run1, run2)
+
+
+def test_start_request_id_dedup(env):
+    _, _, engine = env
+    run1 = engine.start_workflow_execution(start_req(request_id="r1"))
+    run2 = engine.start_workflow_execution(start_req(request_id="r1"))
+    assert run1 == run2
+
+
+def test_signal_with_start(env):
+    _, _, engine = env
+    # no workflow: starts one with the signal first
+    run_id = engine.signal_with_start_workflow_execution(
+        SignalWithStartRequest(
+            start=start_req(), signal_name="kick", signal_input=b"1"
+        )
+    )
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    types = [e.event_type for e in history]
+    assert types == [
+        EventType.WorkflowExecutionStarted,
+        EventType.WorkflowExecutionSignaled,
+        EventType.DecisionTaskScheduled,
+    ]
+    # running workflow: signals in place
+    run_id2 = engine.signal_with_start_workflow_execution(
+        SignalWithStartRequest(
+            start=start_req(), signal_name="kick", signal_input=b"2"
+        )
+    )
+    assert run_id2 == run_id
+
+
+def test_continue_as_new(env):
+    bundle, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [Decision(DecisionType.ContinueAsNewWorkflowExecution, {})],
+    )
+    desc = engine.describe_workflow_execution("dom", "wf1", run_id)
+    assert desc.close_status == int(CloseStatus.ContinuedAsNew)
+    cur = bundle.execution.get_current_execution(1, domain_id(engine), "wf1")
+    assert cur.run_id != run_id
+    history, _ = engine.get_workflow_execution_history(
+        "dom", "wf1", cur.run_id
+    )
+    assert [e.event_type for e in history] == [
+        EventType.WorkflowExecutionStarted,
+        EventType.DecisionTaskScheduled,
+    ]
+    assert history[0].attributes["continued_execution_run_id"] == run_id
+
+
+def test_activity_heartbeat_and_cancel(env):
+    bundle, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [
+            Decision(
+                DecisionType.ScheduleActivityTask,
+                {
+                    "activity_id": "a1",
+                    "activity_type": "hb",
+                    "schedule_to_close_timeout_seconds": 60,
+                    "heartbeat_timeout_seconds": 5,
+                },
+            )
+        ],
+    )
+    d_id = domain_id(engine)
+    resp = bundle.execution.get_workflow_execution(1, d_id, "wf1", run_id)
+    schedule_id = int(next(iter(resp.snapshot["pending_activities"])))
+    atask = engine.record_activity_task_started(
+        d_id, "wf1", run_id, schedule_id, "p1"
+    )
+    assert (
+        engine.record_activity_task_heartbeat(
+            atask["task_token"], details=b"50%"
+        )
+        is False
+    )
+    # a signal triggers the next decision, which cancels the activity
+    engine.signal_workflow_execution(
+        SignalRequest(domain="dom", workflow_id="wf1", signal_name="stop")
+    )
+    task = poll_decision(engine, run_id, req="poll-2")
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [
+            Decision(
+                DecisionType.RequestCancelActivityTask, {"activity_id": "a1"}
+            )
+        ],
+    )
+    assert (
+        engine.record_activity_task_heartbeat(
+            atask["task_token"], details=b"60%"
+        )
+        is True
+    )
+    engine.respond_activity_task_canceled(atask["task_token"], details=b"bye")
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    types = [e.event_type for e in history]
+    assert EventType.ActivityTaskCancelRequested in types
+    assert EventType.ActivityTaskCanceled in types
+
+
+def test_timer_decision(env):
+    _, _, engine = env
+    run_id = engine.start_workflow_execution(start_req())
+    task = poll_decision(engine, run_id)
+    engine.respond_decision_task_completed(
+        task["task_token"],
+        [
+            Decision(
+                DecisionType.StartTimer,
+                {"timer_id": "t1", "start_to_fire_timeout_seconds": 30},
+            ),
+            Decision(DecisionType.RecordMarker, {"marker_name": "m1"}),
+        ],
+    )
+    history, _ = engine.get_workflow_execution_history("dom", "wf1", run_id)
+    types = [e.event_type for e in history]
+    assert EventType.TimerStarted in types
+    assert EventType.MarkerRecorded in types
