@@ -1,0 +1,15 @@
+"""Service clients: ring-routed access to history and matching.
+
+Reference: /root/reference/client/ — per-service clients that resolve
+the owning host through the membership ring and dispatch RPCs
+(history routes by workflowID → shard → host,
+client/history/client.go:844-846; matching routes by task list). In
+this build dispatch is an in-process call into the target host's
+engine registry; a gRPC transport can replace `_dispatch` without
+touching callers.
+"""
+
+from .history import HistoryClient
+from .matching import MatchingClient
+
+__all__ = ["HistoryClient", "MatchingClient"]

@@ -1,0 +1,161 @@
+"""History client: workflowID → shard → owning host → engine.
+
+Reference: /root/reference/client/history/client.go (GetClientForKey
+routing :844-846) + clientBean. Every call resolves the target shard's
+engine at call time, so shard movement between calls is handled by the
+receiving controller (ShardOwnershipLostError surfaces to the caller,
+which retries after the ring settles — retryableClient.go).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict
+
+from cadence_tpu.runtime.controller import (
+    ShardController,
+    ShardOwnershipLostError,
+)
+
+_OWNERSHIP_RETRY = 3
+_OWNERSHIP_BACKOFF_S = 0.05
+
+
+class HistoryClient:
+    """Routes engine calls through one or more in-process controllers.
+
+    ``controllers`` maps host identity → ShardController; the owning
+    host for a shard is whichever controller claims it. A single-host
+    deployment passes one controller.
+    """
+
+    def __init__(self, controllers) -> None:
+        if isinstance(controllers, ShardController):
+            controllers = {controllers.identity: controllers}
+        self._controllers: Dict[str, ShardController] = dict(controllers)
+
+    def add_host(self, controller: ShardController) -> None:
+        self._controllers[controller.identity] = controller
+
+    def remove_host(self, identity: str) -> None:
+        self._controllers.pop(identity, None)
+
+    def _engine_for(self, workflow_id: str):
+        last_err = None
+        for attempt in range(_OWNERSHIP_RETRY):
+            for controller in self._controllers.values():
+                try:
+                    return controller.get_engine(workflow_id)
+                except ShardOwnershipLostError as e:
+                    last_err = e
+            time.sleep(_OWNERSHIP_BACKOFF_S * (attempt + 1))
+        raise last_err or ShardOwnershipLostError(-1, "<unknown>")
+
+    def _call(self, workflow_id: str, method: str, *args, **kwargs):
+        return getattr(self._engine_for(workflow_id), method)(*args, **kwargs)
+
+    # -- workflow mutations (routed by workflow_id) --------------------
+
+    def start_workflow_execution(self, request, **kwargs):
+        return self._call(
+            request.workflow_id, "start_workflow_execution", request, **kwargs
+        )
+
+    def signal_workflow_execution(self, request):
+        return self._call(
+            request.workflow_id, "signal_workflow_execution", request
+        )
+
+    def signal_with_start_workflow_execution(self, request):
+        return self._call(
+            request.workflow_id, "signal_with_start_workflow_execution",
+            request,
+        )
+
+    def terminate_workflow_execution(self, domain_name, workflow_id, run_id="",
+                                     **kwargs):
+        return self._call(
+            workflow_id, "terminate_workflow_execution", domain_name,
+            workflow_id, run_id, **kwargs
+        )
+
+    def request_cancel_workflow_execution(self, domain_name, workflow_id,
+                                          run_id="", **kwargs):
+        return self._call(
+            workflow_id, "request_cancel_workflow_execution", domain_name,
+            workflow_id, run_id, **kwargs
+        )
+
+    def record_decision_task_started(self, domain_id, workflow_id, run_id,
+                                     schedule_id, request_id, identity=""):
+        return self._call(
+            workflow_id, "record_decision_task_started", domain_id,
+            workflow_id, run_id, schedule_id, request_id, identity,
+        )
+
+    def record_activity_task_started(self, domain_id, workflow_id, run_id,
+                                     schedule_id, request_id, identity=""):
+        return self._call(
+            workflow_id, "record_activity_task_started", domain_id,
+            workflow_id, run_id, schedule_id, request_id, identity,
+        )
+
+    def respond_decision_task_completed(self, task_token, decisions, **kwargs):
+        return self._call(
+            task_token["workflow_id"], "respond_decision_task_completed",
+            task_token, decisions, **kwargs
+        )
+
+    def respond_decision_task_failed(self, task_token, **kwargs):
+        return self._call(
+            task_token["workflow_id"], "respond_decision_task_failed",
+            task_token, **kwargs
+        )
+
+    def respond_activity_task_completed(self, task_token, **kwargs):
+        return self._call(
+            task_token["workflow_id"], "respond_activity_task_completed",
+            task_token, **kwargs
+        )
+
+    def respond_activity_task_failed(self, task_token, **kwargs):
+        return self._call(
+            task_token["workflow_id"], "respond_activity_task_failed",
+            task_token, **kwargs
+        )
+
+    def respond_activity_task_canceled(self, task_token, **kwargs):
+        return self._call(
+            task_token["workflow_id"], "respond_activity_task_canceled",
+            task_token, **kwargs
+        )
+
+    def record_activity_task_heartbeat(self, task_token, **kwargs):
+        return self._call(
+            task_token["workflow_id"], "record_activity_task_heartbeat",
+            task_token, **kwargs
+        )
+
+    def record_child_execution_completed(self, domain_id, workflow_id, run_id,
+                                         initiated_id, close_event_type,
+                                         **close_attrs):
+        return self._call(
+            workflow_id, "record_child_execution_completed", domain_id,
+            workflow_id, run_id, initiated_id, close_event_type,
+            **close_attrs
+        )
+
+    # -- reads ---------------------------------------------------------
+
+    def get_workflow_execution_history(self, domain_name, workflow_id,
+                                       run_id="", **kwargs):
+        return self._call(
+            workflow_id, "get_workflow_execution_history", domain_name,
+            workflow_id, run_id, **kwargs
+        )
+
+    def describe_workflow_execution(self, domain_name, workflow_id, run_id=""):
+        return self._call(
+            workflow_id, "describe_workflow_execution", domain_name,
+            workflow_id, run_id,
+        )

@@ -1,0 +1,62 @@
+"""Matching client: thin routed wrapper over MatchingEngine hosts.
+
+Reference: /root/reference/client/matching/client.go — routes by task
+list name through the membership ring; the in-process transport keeps a
+host registry and a load-balancer hook mirroring
+client/matching/loadbalancer.go.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from cadence_tpu.runtime.membership import Monitor
+
+
+class MatchingClient:
+    def __init__(self, engines, monitor: Optional[Monitor] = None) -> None:
+        """``engines``: MatchingEngine, or {host identity → engine}."""
+        if not isinstance(engines, dict):
+            engines = {"matching": engines}
+        self._engines: Dict[str, object] = dict(engines)
+        self._monitor = monitor
+
+    def _engine_for(self, task_list: str):
+        if len(self._engines) == 1 or self._monitor is None:
+            return next(iter(self._engines.values()))
+        host = self._monitor.resolver("matching").lookup(task_list).identity
+        return self._engines.get(host) or next(iter(self._engines.values()))
+
+    def add_decision_task(self, domain_id, workflow_id, run_id, task_list,
+                          schedule_id, schedule_to_start_timeout_seconds=0):
+        return self._engine_for(task_list).add_decision_task(
+            domain_id, workflow_id, run_id, task_list, schedule_id,
+            schedule_to_start_timeout_seconds,
+        )
+
+    def add_activity_task(self, domain_id, workflow_id, run_id, task_list,
+                          schedule_id, schedule_to_start_timeout_seconds=0):
+        return self._engine_for(task_list).add_activity_task(
+            domain_id, workflow_id, run_id, task_list, schedule_id,
+            schedule_to_start_timeout_seconds,
+        )
+
+    def poll_for_decision_task(self, request):
+        return self._engine_for(request.task_list).poll_for_decision_task(
+            request
+        )
+
+    def poll_for_activity_task(self, request):
+        return self._engine_for(request.task_list).poll_for_activity_task(
+            request
+        )
+
+    def describe_task_list(self, domain_id, name, task_type):
+        return self._engine_for(name).describe_task_list(
+            domain_id, name, task_type
+        )
+
+    def cancel_outstanding_polls(self, domain_id, name, task_type):
+        return self._engine_for(name).cancel_outstanding_polls(
+            domain_id, name, task_type
+        )

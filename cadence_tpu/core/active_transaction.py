@@ -133,6 +133,14 @@ class ActiveTransaction:
 
     # -- plumbing -----------------------------------------------------
 
+    def schedule_transfer_task(self, task: T.TransferTask) -> None:
+        """Stage an out-of-band transfer task (queue processors)."""
+        self._extra_transfer.append(task)
+
+    def schedule_timer_task(self, task: T.TimerTask) -> None:
+        """Stage an out-of-band timer task (timer re-arm, retry timers)."""
+        self._extra_timer.append(task)
+
     def _next_id(self) -> int:
         return self.ms.next_event_id + len(self.batch)
 

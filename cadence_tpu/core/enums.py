@@ -176,6 +176,24 @@ class DecisionTaskFailedCause(enum.IntEnum):
     BadSearchAttributes = 22
 
 
+class CancelExternalWorkflowFailedCause(enum.IntEnum):
+    """reference: shared.thrift CancelExternalWorkflowExecutionFailedCause."""
+
+    UnknownExternalWorkflowExecution = 0
+
+
+class SignalExternalWorkflowFailedCause(enum.IntEnum):
+    """reference: shared.thrift SignalExternalWorkflowExecutionFailedCause."""
+
+    UnknownExternalWorkflowExecution = 0
+
+
+class ChildWorkflowFailedCause(enum.IntEnum):
+    """reference: shared.thrift ChildWorkflowExecutionFailedCause."""
+
+    WorkflowAlreadyRunning = 0
+
+
 class TransferTaskType(enum.IntEnum):
     """Transfer-queue task kinds (reference: common/persistence TransferTaskType*)."""
 

@@ -618,6 +618,53 @@ class MutableState:
         if self.activity_by_id.get(ai.activity_id) == schedule_id:
             del self.activity_by_id[ai.activity_id]
 
+    def retry_activity(self, ai: ActivityInfo, now: int, failure_reason: str = ""):
+        """Schedule the next attempt in place; returns the
+        ActivityRetryTimer task or None when retries are exhausted
+        (reference: mutableStateBuilder.go RetryActivity). No history
+        event is written — only the final failure is recorded."""
+        from cadence_tpu.utils.backoff import (
+            NO_INTERVAL,
+            RetryPolicy as BackoffPolicy,
+            next_backoff_interval_seconds,
+        )
+
+        from .tasks import TimerTask
+        from .enums import TimerTaskType
+
+        if not ai.has_retry_policy or ai.cancel_requested:
+            return None
+        policy = BackoffPolicy(
+            initial_interval_seconds=ai.initial_interval,
+            backoff_coefficient=ai.backoff_coefficient,
+            maximum_interval_seconds=ai.maximum_interval,
+            maximum_attempts=ai.maximum_attempts,
+            expiration_seconds=1 if ai.expiration_time else 0,
+            non_retriable_errors=tuple(ai.non_retriable_errors),
+        )
+        interval = next_backoff_interval_seconds(
+            policy, ai.attempt, ai.expiration_time, now,
+            error_reason=failure_reason,
+        )
+        if interval == NO_INTERVAL:
+            return None
+        ai.version = self.current_version
+        ai.attempt += 1
+        ai.scheduled_time = now + interval * SECOND
+        ai.started_id = EMPTY_EVENT_ID
+        ai.started_time = 0
+        ai.request_id = ""
+        ai.timer_task_status = TIMER_TASK_STATUS_NONE
+        if failure_reason:
+            ai.last_failure_reason = failure_reason
+        return TimerTask(
+            task_type=TimerTaskType.ActivityRetryTimer,
+            visibility_timestamp=ai.scheduled_time,
+            event_id=ai.schedule_id,
+            schedule_attempt=ai.attempt,
+            version=ai.version,
+        )
+
     def replicate_activity_task_completed_event(self, event: HistoryEvent) -> None:
         # reference: mutableStateBuilder.go:2132-2140
         self._delete_activity(event.attributes.get("scheduled_event_id", EMPTY_EVENT_ID))

@@ -1,0 +1,281 @@
+"""MatchingEngine: task-list manager registry + Add/Poll task RPCs.
+
+Reference: /root/reference/service/matching/matchingEngine.go:118-683 —
+AddDecisionTask/AddActivityTask persist-or-sync-match through a
+taskListManager; PollForDecisionTask/PollForActivityTask rendezvous with
+the matcher then call back into history (RecordDecisionTaskStarted /
+RecordActivityTaskStarted) to materialize the Started event before
+returning the task to the worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+from cadence_tpu.runtime.api import (
+    EntityNotExistsServiceError,
+    PollForActivityTaskResponse,
+    PollForDecisionTaskResponse,
+)
+from cadence_tpu.runtime.persistence.interfaces import TaskManager
+from cadence_tpu.runtime.persistence.records import TaskInfo
+from cadence_tpu.utils.clock import RealTimeSource, TimeSource
+from cadence_tpu.utils.dynamicconfig import Collection
+from cadence_tpu.utils.log import get_logger
+from cadence_tpu.utils.metrics import NOOP, Scope
+
+from .forwarder import Forwarder
+from .matcher import TaskMatcher
+from .poller_history import PollerHistory
+from .task_list import (
+    TASK_TYPE_ACTIVITY,
+    TASK_TYPE_DECISION,
+    InternalTask,
+    TaskListID,
+    TaskListManager,
+)
+
+
+@dataclasses.dataclass
+class PollRequest:
+    domain_id: str
+    task_list: str
+    identity: str = ""
+    timeout_s: float = 1.0
+
+
+class MatchingEngine:
+    def __init__(
+        self,
+        task_manager: TaskManager,
+        history_client,  # record_decision_task_started / record_activity_task_started
+        config: Optional[Collection] = None,
+        time_source: Optional[TimeSource] = None,
+        metrics: Scope = NOOP,
+    ) -> None:
+        self._store = task_manager
+        self._history = history_client
+        self._time = time_source or RealTimeSource()
+        self._log = get_logger("cadence_tpu.matching")
+        self.metrics = metrics.tagged(service="matching")
+        self._lock = threading.Lock()
+        self._managers: Dict[tuple, TaskListManager] = {}
+        self._pollers: Dict[tuple, PollerHistory] = {}
+        cfg = config or Collection()
+        self._n_write_partitions = cfg.int_property(
+            "matching.numTasklistWritePartitions", 1
+        )
+        self._n_read_partitions = cfg.int_property(
+            "matching.numTasklistReadPartitions", 1
+        )
+        self._tasklist_rps = cfg.float_property("matching.rps", 100000.0)
+
+    # -- manager registry ----------------------------------------------
+
+    def _get_manager(self, tl_id: TaskListID) -> TaskListManager:
+        key = tl_id.key()
+        with self._lock:
+            mgr = self._managers.get(key)
+            if mgr is None:
+                forwarder = Forwarder(tl_id, self)
+                matcher = TaskMatcher(
+                    forward_offer=(
+                        forwarder.forward_offer if forwarder.enabled else None
+                    ),
+                    forward_poll=(
+                        forwarder.forward_poll if forwarder.enabled else None
+                    ),
+                )
+                mgr = TaskListManager(
+                    tl_id, self._store, matcher, time_source=self._time
+                )
+                self._managers[key] = mgr
+            return mgr
+
+    def _pick_partition(self, domain_id: str, name: str, write: bool) -> str:
+        if TaskListID("", name, 0).is_partition:
+            return name  # already partition-addressed
+        n = (
+            self._n_write_partitions(domain=domain_id, task_list=name)
+            if write
+            else self._n_read_partitions(domain=domain_id, task_list=name)
+        )
+        if n <= 1:
+            return name
+        return TaskListID.partition_name(name, random.randrange(n))
+
+    # -- add (called by history transfer queue) ------------------------
+
+    def _add_task(
+        self, domain_id: str, name: str, task_type: int, info: TaskInfo
+    ) -> bool:
+        part = self._pick_partition(domain_id, name, write=True)
+        mgr = self._get_manager(TaskListID(domain_id, part, task_type))
+        return mgr.add_task(info)
+
+    def add_decision_task(
+        self,
+        domain_id: str,
+        workflow_id: str,
+        run_id: str,
+        task_list: str,
+        schedule_id: int,
+        schedule_to_start_timeout_seconds: int = 0,
+    ) -> bool:
+        return self._add_task(
+            domain_id, task_list, TASK_TYPE_DECISION,
+            TaskInfo(
+                domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
+                task_id=0, schedule_id=schedule_id,
+                schedule_to_start_timeout_seconds=schedule_to_start_timeout_seconds,
+            ),
+        )
+
+    def add_activity_task(
+        self,
+        domain_id: str,
+        workflow_id: str,
+        run_id: str,
+        task_list: str,
+        schedule_id: int,
+        schedule_to_start_timeout_seconds: int = 0,
+    ) -> bool:
+        return self._add_task(
+            domain_id, task_list, TASK_TYPE_ACTIVITY,
+            TaskInfo(
+                domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
+                task_id=0, schedule_id=schedule_id,
+                schedule_to_start_timeout_seconds=schedule_to_start_timeout_seconds,
+            ),
+        )
+
+    # -- poll (called by workers via frontend) -------------------------
+
+    def _poll_loop(self, req: PollRequest, task_type: int):
+        """Poll → record-started → respond; stale tasks are acked and the
+        poll continues until the deadline (matchingEngine.getTask loop)."""
+        part = self._pick_partition(req.domain_id, req.task_list, write=False)
+        tl_id = TaskListID(req.domain_id, part, task_type)
+        mgr = self._get_manager(tl_id)
+        self._poller_history(tl_id).record(req.identity)
+        deadline = time.monotonic() + req.timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None, None
+            task: Optional[InternalTask] = mgr.get_task(remaining)
+            if task is None:
+                continue  # interrupted or forwarded miss; re-check deadline
+            info = task.info
+            request_id = str(uuid.uuid4())
+            try:
+                if task_type == TASK_TYPE_DECISION:
+                    resp = self._history.record_decision_task_started(
+                        info.domain_id, info.workflow_id, info.run_id,
+                        info.schedule_id, request_id, req.identity,
+                    )
+                else:
+                    resp = self._history.record_activity_task_started(
+                        info.domain_id, info.workflow_id, info.run_id,
+                        info.schedule_id, request_id, req.identity,
+                    )
+            except EntityNotExistsServiceError as e:
+                task.finish(e)  # stale task (already started/completed)
+                continue
+            except Exception as e:  # transient history failure
+                task.finish(e)
+                raise
+            task.finish(None)
+            return task, resp
+
+    def poll_for_decision_task(
+        self, req: PollRequest
+    ) -> Optional[PollForDecisionTaskResponse]:
+        task, resp = self._poll_loop(req, TASK_TYPE_DECISION)
+        if task is None:
+            return None
+        return PollForDecisionTaskResponse(
+            task_token=resp["task_token"],
+            workflow_id=task.info.workflow_id,
+            run_id=task.info.run_id,
+            workflow_type=resp["workflow_type"],
+            previous_started_event_id=resp["previous_started_event_id"],
+            started_event_id=resp["started_event_id"],
+            attempt=resp["attempt"],
+            history=resp["history"],
+        )
+
+    def poll_for_activity_task(
+        self, req: PollRequest
+    ) -> Optional[PollForActivityTaskResponse]:
+        task, resp = self._poll_loop(req, TASK_TYPE_ACTIVITY)
+        if task is None:
+            return None
+        scheduled = resp["scheduled_event"]
+        attrs = scheduled.attributes if scheduled is not None else {}
+        return PollForActivityTaskResponse(
+            task_token=resp["task_token"],
+            workflow_id=task.info.workflow_id,
+            run_id=task.info.run_id,
+            activity_id=resp["activity_id"],
+            activity_type=attrs.get("activity_type", ""),
+            input=attrs.get("input", b""),
+            scheduled_timestamp=resp["scheduled_time"],
+            started_timestamp=resp["started_time"],
+            schedule_to_close_timeout_seconds=resp[
+                "schedule_to_close_timeout_seconds"
+            ],
+            start_to_close_timeout_seconds=resp["start_to_close_timeout_seconds"],
+            heartbeat_timeout_seconds=resp["heartbeat_timeout_seconds"],
+            attempt=resp["attempt"],
+            heartbeat_details=resp["heartbeat_details"],
+        )
+
+    # -- admin ----------------------------------------------------------
+
+    def _poller_history(self, tl_id: TaskListID) -> PollerHistory:
+        with self._lock:
+            ph = self._pollers.get(tl_id.key())
+            if ph is None:
+                ph = self._pollers[tl_id.key()] = PollerHistory()
+            return ph
+
+    def describe_task_list(
+        self, domain_id: str, name: str, task_type: int
+    ) -> dict:
+        tl_id = TaskListID(domain_id, name, task_type)
+        with self._lock:
+            mgr = self._managers.get(tl_id.key())
+        out = mgr.describe() if mgr else {"task_list": name, "task_type": task_type}
+        out["pollers"] = self._poller_history(tl_id).get()
+        return out
+
+    def cancel_outstanding_polls(
+        self, domain_id: str, name: str, task_type: int
+    ) -> None:
+        with self._lock:
+            mgr = self._managers.get(TaskListID(domain_id, name, task_type).key())
+        if mgr is not None:
+            mgr.matcher.interrupt_all()
+
+    def unload_idle_task_lists(self) -> int:
+        """GC managers idle past their TTL (taskListManager idle unload)."""
+        removed = 0
+        with self._lock:
+            for key, mgr in list(self._managers.items()):
+                if mgr.idle_since_s() > mgr.idle_ttl_s:
+                    mgr.stop()
+                    del self._managers[key]
+                    removed += 1
+        return removed
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for mgr in self._managers.values():
+                mgr.stop()
+            self._managers.clear()

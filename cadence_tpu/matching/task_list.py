@@ -1,0 +1,280 @@
+"""TaskListManager: per-task-list daemon — lease, backlog pump, GC.
+
+Reference: /root/reference/service/matching/taskListManager.go:120-565
+(lease + taskID block allocation), taskReader.go (backlog pump),
+taskWriter.go (batched appends with block fencing), ackManager.go,
+taskGC.go. One manager owns one (domain, name, task_type) queue:
+producers sync-match through the TaskMatcher when a poller is waiting,
+otherwise the task is persisted and later dispatched by the reader pump.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cadence_tpu.runtime.persistence.errors import ConditionFailedError
+from cadence_tpu.runtime.queues.ack import QueueAckManager
+from cadence_tpu.runtime.persistence.interfaces import TaskManager
+from cadence_tpu.runtime.persistence.records import TaskInfo, TaskListInfo
+from cadence_tpu.utils.clock import RealTimeSource, TimeSource
+from cadence_tpu.utils.log import get_logger
+
+# taskID block leased per rangeID bump (reference rangeSize=100k)
+RANGE_SIZE = 100_000
+
+TASK_TYPE_DECISION = 0
+TASK_TYPE_ACTIVITY = 1
+
+
+class TaskListID:
+    """(domain_id, name, task_type) triple, partition-aware.
+
+    Scalable task lists name partitions ``/__cadence_sys/{base}/{n}``
+    (reference taskListID parsing, forwarder.go).
+    """
+
+    PARTITION_PREFIX = "/__cadence_sys/"
+
+    def __init__(self, domain_id: str, name: str, task_type: int) -> None:
+        self.domain_id = domain_id
+        self.name = name
+        self.task_type = task_type
+
+    @property
+    def is_partition(self) -> bool:
+        return self.name.startswith(self.PARTITION_PREFIX)
+
+    @property
+    def base_name(self) -> str:
+        if not self.is_partition:
+            return self.name
+        rest = self.name[len(self.PARTITION_PREFIX):]
+        base, _, _ = rest.rpartition("/")
+        return base
+
+    @property
+    def partition(self) -> int:
+        if not self.is_partition:
+            return 0
+        _, _, n = self.name.rpartition("/")
+        try:
+            return int(n)
+        except ValueError:
+            return 0
+
+    @classmethod
+    def partition_name(cls, base: str, n: int) -> str:
+        return base if n == 0 else f"{cls.PARTITION_PREFIX}{base}/{n}"
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.domain_id, self.name, self.task_type)
+
+    def __repr__(self) -> str:
+        return f"TaskListID({self.domain_id!r}, {self.name!r}, {self.task_type})"
+
+
+class InternalTask:
+    """A dispatched task: persisted backlog entry or ephemeral sync match."""
+
+    __slots__ = ("info", "_finish", "finished", "sync", "started_response")
+
+    def __init__(
+        self, info: TaskInfo, finish: Optional[Callable[[Optional[Exception]], None]],
+        sync: bool = False,
+    ) -> None:
+        self.info = info
+        self._finish = finish
+        self.finished = False
+        self.sync = sync
+        self.started_response = None
+
+    def finish(self, error: Optional[Exception] = None) -> None:
+        if self.finished:
+            return
+        self.finished = True
+        if self._finish is not None:
+            self._finish(error)
+
+
+class TaskListManager:
+    def __init__(
+        self,
+        task_list_id: TaskListID,
+        task_manager: TaskManager,
+        matcher,
+        time_source: Optional[TimeSource] = None,
+        idle_tasklist_ttl_s: float = 300.0,
+        max_sync_match_wait_s: float = 0.2,
+    ) -> None:
+        self.id = task_list_id
+        self._store = task_manager
+        self.matcher = matcher
+        self._time = time_source or RealTimeSource()
+        self._log = get_logger(
+            "cadence_tpu.matching.tasklist", task_list=task_list_id.name
+        )
+        self._write_lock = threading.Lock()
+        self._info = self._lease()
+        # leased block: (rangeID-1)*RANGE_SIZE+1 .. rangeID*RANGE_SIZE
+        self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
+        self._max_task_id = self._info.range_id * RANGE_SIZE
+        self._ack = QueueAckManager(self._info.ack_level)
+        self._backlog_signal = threading.Event()
+        self._stopped = threading.Event()
+        self._last_activity = self._time.now()
+        self._max_sync_wait = max_sync_match_wait_s
+        self.idle_ttl_s = idle_tasklist_ttl_s
+        self._reader = threading.Thread(
+            target=self._read_pump, name=f"taskReader-{task_list_id.name}",
+            daemon=True,
+        )
+        self._reader.start()
+
+    # -- lease / block allocation (taskWriter block fencing) ------------
+
+    def _lease(self) -> TaskListInfo:
+        return self._store.lease_task_list(
+            self.id.domain_id, self.id.name, self.id.task_type
+        )
+
+    def _allocate_task_id(self) -> int:
+        # caller holds _write_lock
+        if self._next_task_id > self._max_task_id:
+            self._info = self._lease()
+            self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
+            self._max_task_id = self._info.range_id * RANGE_SIZE
+        tid = self._next_task_id
+        self._next_task_id += 1
+        return tid
+
+    # -- producer -------------------------------------------------------
+
+    def add_task(self, info: TaskInfo) -> bool:
+        """Sync-match if a poller waits and no backlog; else persist.
+
+        Returns True when the task was sync-matched (never persisted).
+        Reference taskListManager.AddTask: backlog present ⇒ skip sync
+        match to preserve dispatch order.
+        """
+        self._touch()
+        if not self._has_backlog():
+            task = InternalTask(info, finish=None, sync=True)
+            if self.matcher.offer(task, timeout=self._max_sync_wait):
+                return True
+        with self._write_lock:
+            info.task_id = self._allocate_task_id()
+            if info.created_time == 0:
+                info.created_time = self._time.now()
+            if info.schedule_to_start_timeout_seconds > 0 and info.expiry_time == 0:
+                info.expiry_time = info.created_time + int(
+                    info.schedule_to_start_timeout_seconds * 1e9
+                )
+            try:
+                self._store.create_tasks(self._info, [info])
+            except ConditionFailedError:
+                # lost the lease (another owner); re-lease and retry once
+                self._info = self._lease()
+                self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
+                self._max_task_id = self._info.range_id * RANGE_SIZE
+                info.task_id = self._allocate_task_id()
+                self._store.create_tasks(self._info, [info])
+        self._backlog_signal.set()
+        return False
+
+    # -- consumer -------------------------------------------------------
+
+    def get_task(self, timeout: float) -> Optional[InternalTask]:
+        self._touch()
+        return self.matcher.poll(timeout)
+
+    # -- backlog pump (taskReader) --------------------------------------
+
+    def _has_backlog(self) -> bool:
+        return self._ack.read_level > self._ack.ack_level or bool(
+            self._outstanding_count()
+        )
+
+    def _outstanding_count(self) -> int:
+        return self._ack.outstanding()
+
+    def _read_pump(self) -> None:
+        while not self._stopped.is_set():
+            self._backlog_signal.wait(timeout=0.1)
+            self._backlog_signal.clear()
+            if self._stopped.is_set():
+                return
+            while True:
+                batch = self._store.get_tasks(
+                    self.id.domain_id, self.id.name, self.id.task_type,
+                    read_level=self._ack.read_level,
+                    max_read_level=self._max_task_id,
+                    batch_size=64,
+                )
+                if not batch:
+                    break
+                now = self._time.now()
+                for info in batch:
+                    self._ack.add(info.task_id)
+                    if info.expiry_time and info.expiry_time < now:
+                        self._complete(info.task_id)  # expired: ack + GC
+                        continue
+                    task = InternalTask(
+                        info,
+                        finish=lambda err, tid=info.task_id: self._on_finish(
+                            tid, err
+                        ),
+                    )
+                    if not self.matcher.must_offer(task):
+                        return  # shutdown
+
+    def _on_finish(self, task_id: int, error: Optional[Exception]) -> None:
+        # both success and a stale-task error ack the task; a transient
+        # error would re-deliver in the reference, we ack-and-log
+        if error is not None:
+            self._log.info(f"task {task_id} finished with error: {error}")
+        self._complete(task_id)
+
+    def _complete(self, task_id: int) -> None:
+        self._ack.complete(task_id)
+        ack = self._ack.update_ack_level()
+        self._store.complete_task(
+            self.id.domain_id, self.id.name, self.id.task_type, task_id
+        )
+        # taskGC: range-delete below ack level, persist ack level
+        self._store.complete_tasks_less_than(
+            self.id.domain_id, self.id.name, self.id.task_type, ack
+        )
+        self._info.ack_level = ack
+        try:
+            self._store.update_task_list(self._info)
+        except ConditionFailedError:
+            pass  # lease moved; new owner persists its own ack level
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _touch(self) -> None:
+        self._last_activity = self._time.now()
+
+    def idle_since_s(self) -> float:
+        return (self._time.now() - self._last_activity) / 1e9
+
+    def describe(self) -> dict:
+        return {
+            "task_list": self.id.name,
+            "task_type": self.id.task_type,
+            "range_id": self._info.range_id,
+            "ack_level": self._ack.ack_level,
+            "read_level": self._ack.read_level,
+            "backlog_hint": self._outstanding_count(),
+        }
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._backlog_signal.set()
+        self.matcher.shutdown()
+        try:
+            self._store.update_task_list(self._info)
+        except ConditionFailedError:
+            pass

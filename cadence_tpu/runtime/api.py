@@ -78,6 +78,13 @@ class StartWorkflowRequest:
     cron_schedule: str = ""
     memo: Optional[Dict[str, bytes]] = None
     search_attributes: Optional[Dict[str, bytes]] = None
+    # parent execution (set when started as a child workflow by the
+    # transfer queue; reference: historyEngine StartWorkflowExecution
+    # with ParentExecutionInfo)
+    parent_domain: str = ""
+    parent_workflow_id: str = ""
+    parent_run_id: str = ""
+    parent_initiated_id: int = 0
 
     def validate(self) -> None:
         if not self.domain:

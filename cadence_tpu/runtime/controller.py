@@ -1,0 +1,145 @@
+"""Shard controller: acquire/release shard engines on membership change.
+
+Reference: /root/reference/service/history/shardController.go:96,148-389 —
+one engine per owned shard; a management pump re-evaluates ownership on
+every membership ChangedEvent, acquiring newly-owned shards and
+releasing stolen ones (the new owner's lease bump fences the old one).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from cadence_tpu.utils.clock import TimeSource
+from cadence_tpu.utils.hashing import shard_for_workflow
+from cadence_tpu.utils.log import get_logger
+
+from .domains import DomainCache
+from .engine.engine import HistoryEngine
+from .membership import Monitor, ServiceResolver
+from .persistence.interfaces import PersistenceBundle
+from .shard import ShardContext
+
+
+class ShardOwnershipLostError(Exception):
+    def __init__(self, shard_id: int, owner: str) -> None:
+        super().__init__(f"shard {shard_id} owned by {owner}")
+        self.shard_id = shard_id
+        self.owner = owner
+
+
+class _ShardHandle:
+    """One owned shard: context + engine + queue processors."""
+
+    def __init__(self, shard: ShardContext, engine: HistoryEngine,
+                 processors: List[object]) -> None:
+        self.shard = shard
+        self.engine = engine
+        self.processors = processors
+
+    def stop(self) -> None:
+        for p in self.processors:
+            p.stop()
+
+
+class ShardController:
+    def __init__(
+        self,
+        num_shards: int,
+        persistence: PersistenceBundle,
+        domain_cache: DomainCache,
+        monitor: Monitor,
+        engine_factory: Optional[Callable[[ShardContext], _ShardHandle]] = None,
+        time_source: Optional[TimeSource] = None,
+    ) -> None:
+        self.num_shards = num_shards
+        self.persistence = persistence
+        self.domains = domain_cache
+        self.monitor = monitor
+        self.identity = monitor.self_identity
+        self._time = time_source
+        self._engine_factory = engine_factory or self._default_factory
+        self._lock = threading.Lock()
+        self._handles: Dict[int, _ShardHandle] = {}
+        self._log = get_logger("cadence_tpu.shardController", host=self.identity)
+        self._resolver: ServiceResolver = monitor.resolver("history")
+        self._resolver.add_listener(
+            f"shardController-{self.identity}", lambda ev: self.acquire_shards()
+        )
+
+    # -- ownership -----------------------------------------------------
+
+    def _owned(self, shard_id: int) -> bool:
+        return self._resolver.lookup(str(shard_id)).identity == self.identity
+
+    def shard_for(self, workflow_id: str) -> int:
+        return shard_for_workflow(workflow_id, self.num_shards)
+
+    def acquire_shards(self) -> None:
+        """Re-evaluate ownership for every shard (acquireShards :279-346)."""
+        for shard_id in range(self.num_shards):
+            try:
+                owned = self._owned(shard_id)
+            except RuntimeError:
+                owned = False  # empty ring
+            with self._lock:
+                have = shard_id in self._handles
+                if owned and not have:
+                    try:
+                        self._handles[shard_id] = self._engine_factory(
+                            self._make_shard(shard_id)
+                        )
+                        self._log.info(f"acquired shard {shard_id}")
+                    except Exception:
+                        self._log.exception(f"failed to acquire shard {shard_id}")
+                elif not owned and have:
+                    self._handles.pop(shard_id).stop()
+                    self._log.info(f"released shard {shard_id}")
+
+    def _make_shard(self, shard_id: int) -> ShardContext:
+        return ShardContext(
+            shard_id, self.persistence, owner=self.identity,
+            time_source=self._time,
+        )
+
+    def _default_factory(self, shard: ShardContext) -> _ShardHandle:
+        engine = HistoryEngine(shard, self.domains)
+        return _ShardHandle(shard, engine, [])
+
+    # -- engine lookup -------------------------------------------------
+
+    def get_engine(self, workflow_id: str) -> HistoryEngine:
+        return self.get_engine_for_shard(self.shard_for(workflow_id))
+
+    def get_engine_for_shard(self, shard_id: int) -> HistoryEngine:
+        with self._lock:
+            handle = self._handles.get(shard_id)
+        if handle is None:
+            try:
+                owner = self._resolver.lookup(str(shard_id)).identity
+            except RuntimeError:
+                owner = "<no hosts>"
+            raise ShardOwnershipLostError(shard_id, owner)
+        return handle.engine
+
+    def owned_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(self._handles)
+
+    def describe(self) -> dict:
+        """DescribeHistoryHost (service/history/handler.go:662)."""
+        with self._lock:
+            return {
+                "identity": self.identity,
+                "shard_count": len(self._handles),
+                "shard_ids": sorted(self._handles),
+                "num_shards_total": self.num_shards,
+            }
+
+    def stop(self) -> None:
+        self._resolver.remove_listener(f"shardController-{self.identity}")
+        with self._lock:
+            for handle in self._handles.values():
+                handle.stop()
+            self._handles.clear()

@@ -77,6 +77,19 @@ class WorkflowExecutionContext:
 
     # -- persist ------------------------------------------------------
 
+    def _stamp_identity(self, run_id: str, *task_lists) -> None:
+        """Stamp workflow identity onto queue tasks (the reference's task
+        rows carry domainID/workflowID/runID; the StateBuilder emits them
+        identity-free so replay stays pure)."""
+        for tasks in task_lists:
+            for t in tasks:
+                if not t.domain_id:
+                    t.domain_id = self.domain_id
+                if not t.workflow_id:
+                    t.workflow_id = self.workflow_id
+                if not t.run_id:
+                    t.run_id = run_id
+
     def _snapshot_of(
         self, ms: MutableState, result_tasks: TransactionResult,
         new_run: bool = False,
@@ -115,6 +128,9 @@ class WorkflowExecutionContext:
         size = self._append_events(branch, result.events)
         ms.execution_info.history_size = size
         self.shard.assign_task_ids(result.transfer_tasks, result.timer_tasks)
+        self._stamp_identity(
+            self.run_id, result.transfer_tasks, result.timer_tasks
+        )
         self.shard.persistence.execution.create_workflow_execution(
             self.shard.shard_id,
             self.shard.range_id,
@@ -134,6 +150,9 @@ class WorkflowExecutionContext:
             size = self._append_events(self.branch_token(ms), result.events)
         ms.execution_info.history_size += size
         self.shard.assign_task_ids(result.transfer_tasks, result.timer_tasks)
+        self._stamp_identity(
+            self.run_id, result.transfer_tasks, result.timer_tasks
+        )
 
         new_snapshot = None
         if result.new_run_ms is not None:
@@ -150,6 +169,11 @@ class WorkflowExecutionContext:
             new_ms.execution_info.history_size = new_size
             self.shard.assign_task_ids(
                 result.new_run_transfer_tasks, result.new_run_timer_tasks
+            )
+            self._stamp_identity(
+                new_run_id,
+                result.new_run_transfer_tasks,
+                result.new_run_timer_tasks,
             )
             new_snapshot = self._snapshot_of(new_ms, result, new_run=True)
 

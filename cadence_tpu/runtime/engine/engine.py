@@ -19,6 +19,7 @@ from cadence_tpu.core.active_transaction import (
 )
 from cadence_tpu.core.enums import (
     CloseStatus,
+    EventType,
     IDReusePolicy,
     TimeoutType,
     WorkflowState,
@@ -141,7 +142,7 @@ class HistoryEngine:
             ms, ctx.domain_id, ctx.workflow_id, ctx.run_id, version,
             request_id=request_id,
             domain_resolver=lambda name: (
-                self.domains.get_by_name(name).info.id if name else ""
+                self.domains.resolve(name).info.id if name else ""
             ),
         )
 
@@ -171,6 +172,9 @@ class HistoryEngine:
         txn = ActiveTransaction(
             ms, domain_id, request.workflow_id, run_id, version,
             request_id=request_id,
+            domain_resolver=lambda name: (
+                self.domains.resolve(name).info.id if name else ""
+            ),
         )
         txn.add_workflow_execution_started(
             now,
@@ -188,6 +192,14 @@ class HistoryEngine:
             cron_schedule=request.cron_schedule,
             memo=request.memo,
             search_attributes=request.search_attributes,
+            parent_workflow_domain=request.parent_domain or None,
+            parent_workflow_id=request.parent_workflow_id or None,
+            parent_run_id=request.parent_run_id or None,
+            parent_initiated_event_id=(
+                request.parent_initiated_id
+                if request.parent_workflow_id
+                else None
+            ),
         )
         if signal_name:
             txn.add_workflow_execution_signaled(
@@ -670,6 +682,152 @@ class HistoryEngine:
             task_token["domain_id"], task_token["workflow_id"],
             task_token["run_id"], action,
         )
+
+    def with_workflow(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        fn: Callable[[WorkflowExecutionContext, MutableState], Any],
+    ) -> Any:
+        """Run ``fn(ctx, ms)`` under the workflow lock with condition
+        retries (read-only callers just return values)."""
+        return self._update_workflow(domain_id, workflow_id, run_id, fn)
+
+    # -- cross-workflow callbacks (invoked by the transfer queue) ------
+    # Reference: transferQueueActiveProcessor.go record*Completed/Failed
+    # helpers and historyEngine.RecordChildExecutionCompleted — each
+    # appends a result event to the source workflow and schedules a
+    # decision if none is pending.
+
+    def _record_external_result(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        mutate: Callable[[ActiveTransaction, MutableState, int], bool],
+    ) -> None:
+        def action(ctx, ms):
+            if not ms.is_workflow_execution_running():
+                raise EntityNotExistsServiceError(
+                    f"workflow {workflow_id} already closed"
+                )
+            now = self.shard.now()
+            txn = self._txn(ctx, ms, ms.current_version)
+            try:
+                if not mutate(txn, ms, now):
+                    return  # duplicate task; nothing to record
+                if not ms.has_pending_decision() and not txn.has_buffered_events():
+                    txn.add_decision_task_scheduled(now)
+            except WorkflowStateError as e:
+                raise EntityNotExistsServiceError(str(e))
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+
+        self._update_workflow(domain_id, workflow_id, run_id, action)
+
+    def record_child_execution_started(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        initiated_id: int, child_domain: str, child_workflow_id: str,
+        child_run_id: str, workflow_type: str,
+    ) -> None:
+        def mutate(txn, ms, now):
+            ci = ms.get_child_execution_info(initiated_id)
+            if ci is None:
+                raise WorkflowStateError(f"child {initiated_id} not pending")
+            if ci.started_id != EMPTY_EVENT_ID:
+                return False  # duplicate start notification
+            txn.add_child_started(
+                initiated_id, child_domain, child_workflow_id, child_run_id,
+                workflow_type, now,
+            )
+            return True
+
+        self._record_external_result(domain_id, workflow_id, run_id, mutate)
+
+    def record_start_child_execution_failed(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        initiated_id: int, child_domain: str, child_workflow_id: str,
+        workflow_type: str, cause: int,
+    ) -> None:
+        def mutate(txn, ms, now):
+            if ms.get_child_execution_info(initiated_id) is None:
+                return False
+            txn.add_start_child_failed(
+                initiated_id, child_domain, child_workflow_id, workflow_type,
+                cause, now,
+            )
+            return True
+
+        self._record_external_result(domain_id, workflow_id, run_id, mutate)
+
+    def record_child_execution_completed(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        initiated_id: int, close_event_type: EventType,
+        child_run_id: str = "",
+        **close_attrs: Any,
+    ) -> None:
+        """Parent-side close notification (historyEngine.go
+        RecordChildExecutionCompleted). ``child_run_id`` backfills the
+        started event when the close raced ahead of the started
+        notification (ci.started_run_id is unset in exactly that race)."""
+
+        def mutate(txn, ms, now):
+            ci = ms.get_child_execution_info(initiated_id)
+            if ci is None:
+                return False  # already recorded (duplicate)
+            if ci.started_id == EMPTY_EVENT_ID:
+                # close raced ahead of the started notification: record
+                # the started event first so the history stays legal
+                txn.add_child_started(
+                    initiated_id, ci.domain_name, ci.started_workflow_id,
+                    ci.started_run_id or child_run_id,
+                    ci.workflow_type_name, now,
+                )
+            txn.add_child_closed(initiated_id, close_event_type, now, **close_attrs)
+            return True
+
+        self._record_external_result(domain_id, workflow_id, run_id, mutate)
+
+    def record_external_cancel_result(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        initiated_id: int, target_domain: str, target_workflow_id: str,
+        target_run_id: str, failed_cause: Optional[int] = None,
+    ) -> None:
+        def mutate(txn, ms, now):
+            if ms.get_request_cancel_info(initiated_id) is None:
+                return False
+            if failed_cause is None:
+                txn.add_external_cancel_requested(
+                    initiated_id, target_domain, target_workflow_id,
+                    target_run_id, now,
+                )
+            else:
+                txn.add_request_cancel_external_failed(
+                    initiated_id, target_domain, target_workflow_id,
+                    target_run_id, failed_cause, now,
+                )
+            return True
+
+        self._record_external_result(domain_id, workflow_id, run_id, mutate)
+
+    def record_external_signal_result(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        initiated_id: int, target_domain: str, target_workflow_id: str,
+        target_run_id: str, control: bytes = b"",
+        failed_cause: Optional[int] = None,
+    ) -> None:
+        def mutate(txn, ms, now):
+            if ms.get_signal_info(initiated_id) is None:
+                return False
+            if failed_cause is None:
+                txn.add_external_signaled(
+                    initiated_id, target_domain, target_workflow_id,
+                    target_run_id, control, now,
+                )
+            else:
+                txn.add_signal_external_failed(
+                    initiated_id, target_domain, target_workflow_id,
+                    target_run_id, failed_cause, now,
+                )
+            return True
+
+        self._record_external_result(domain_id, workflow_id, run_id, mutate)
 
     # -- reads --------------------------------------------------------
 

@@ -1,0 +1,148 @@
+"""Membership: host ring with consistent-hash lookup + change listeners.
+
+Reference: /root/reference/common/membership/interfaces.go:49-79
+(Monitor / ServiceResolver) over ringpop SWIM gossip
+(rpMonitor.go:44, rpServiceResolver.go:45). In this build the gossip
+plane is replaced by an explicitly-driven host set (the onebox test
+strategy, /root/reference/host/simpleMonitor.go): hosts join/leave via
+API calls, listeners fire on change, and Lookup hashes keys onto a
+replicated consistent-hash ring. Multi-host deployments drive the same
+API from their orchestrator (k8s endpoints watch, etc.).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Dict, List, Optional
+
+from cadence_tpu.utils.hashing import fnv1a32
+
+_VNODES = 100  # virtual nodes per host for ring smoothness
+
+
+class HostInfo:
+    def __init__(self, identity: str) -> None:
+        self.identity = identity
+
+    def __repr__(self) -> str:
+        return f"HostInfo({self.identity!r})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, HostInfo) and other.identity == self.identity
+
+    def __hash__(self) -> int:
+        return hash(self.identity)
+
+
+class ChangedEvent:
+    def __init__(self, added: List[str], removed: List[str]) -> None:
+        self.hosts_added = added
+        self.hosts_removed = removed
+
+
+class ServiceResolver:
+    """Consistent-hash ring for one service (rpServiceResolver.go)."""
+
+    def __init__(self, service: str) -> None:
+        self.service = service
+        self._lock = threading.Lock()
+        self._hosts: List[str] = []
+        self._ring: List[int] = []  # sorted vnode hashes
+        self._ring_hosts: Dict[int, str] = {}
+        self._listeners: Dict[str, Callable[[ChangedEvent], None]] = {}
+
+    def _rebuild(self) -> None:
+        self._ring = []
+        self._ring_hosts = {}
+        for host in self._hosts:
+            for v in range(_VNODES):
+                h = fnv1a32(f"{host}#{v}")
+                # first writer wins on (astronomically unlikely) collision
+                if h not in self._ring_hosts:
+                    self._ring_hosts[h] = host
+        self._ring = sorted(self._ring_hosts)
+
+    def set_hosts(self, hosts: List[str]) -> None:
+        with self._lock:
+            old = set(self._hosts)
+            new = set(hosts)
+            self._hosts = sorted(new)
+            self._rebuild()
+            listeners = list(self._listeners.values())
+        event = ChangedEvent(sorted(new - old), sorted(old - new))
+        if event.hosts_added or event.hosts_removed:
+            for cb in listeners:
+                cb(event)
+
+    def members(self) -> List[HostInfo]:
+        with self._lock:
+            return [HostInfo(h) for h in self._hosts]
+
+    def member_count(self) -> int:
+        with self._lock:
+            return len(self._hosts)
+
+    def lookup(self, key: str) -> HostInfo:
+        """key → owning host (Lookup, interfaces.go:74)."""
+        with self._lock:
+            if not self._ring:
+                raise RuntimeError(
+                    f"no hosts in service ring {self.service!r}"
+                )
+            h = fnv1a32(key)
+            idx = bisect.bisect_left(self._ring, h)
+            if idx == len(self._ring):
+                idx = 0
+            return HostInfo(self._ring_hosts[self._ring[idx]])
+
+    def add_listener(
+        self, name: str, cb: Callable[[ChangedEvent], None]
+    ) -> None:
+        with self._lock:
+            self._listeners[name] = cb
+
+    def remove_listener(self, name: str) -> None:
+        with self._lock:
+            self._listeners.pop(name, None)
+
+
+class Monitor:
+    """Per-service rings + this host's identity (membership.Monitor)."""
+
+    SERVICES = ("frontend", "history", "matching", "worker")
+
+    def __init__(self, self_identity: str = "self") -> None:
+        self.self_identity = self_identity
+        self._resolvers: Dict[str, ServiceResolver] = {
+            s: ServiceResolver(s) for s in self.SERVICES
+        }
+
+    def resolver(self, service: str) -> ServiceResolver:
+        r = self._resolvers.get(service)
+        if r is None:
+            r = self._resolvers[service] = ServiceResolver(service)
+        return r
+
+    def whoami(self) -> HostInfo:
+        return HostInfo(self.self_identity)
+
+    def join(self, service: str, identity: Optional[str] = None) -> None:
+        identity = identity or self.self_identity
+        r = self.resolver(service)
+        hosts = [h.identity for h in r.members()]
+        if identity not in hosts:
+            r.set_hosts(hosts + [identity])
+
+    def leave(self, service: str, identity: Optional[str] = None) -> None:
+        identity = identity or self.self_identity
+        r = self.resolver(service)
+        r.set_hosts([h.identity for h in r.members() if h.identity != identity])
+
+
+def single_host_monitor(identity: str = "onebox") -> Monitor:
+    """A monitor where this host owns every service (onebox topology)."""
+    m = Monitor(identity)
+    for s in Monitor.SERVICES:
+        m.join(s)
+    return m

@@ -1,0 +1,27 @@
+"""Per-shard queue processors: transfer, timer, replication.
+
+TPU-native rebuild of the reference history-service queue machinery
+(/root/reference/service/history/queueProcessor.go, queueAckMgr.go,
+taskProcessor.go, timerQueueProcessorBase.go, timerGate.go,
+transferQueueActiveProcessor.go, timerQueueActiveProcessor.go).
+
+These are host-side pull pipelines feeding the engine; on TPU the
+corresponding data-plane work (replay, task refresh) runs as device
+batches, while the queues remain the control plane that orders, acks,
+and retries work items.
+"""
+
+from .ack import QueueAckManager
+from .base import QueueProcessorBase
+from .timer import TimerQueueProcessor
+from .timer_gate import LocalTimerGate, RemoteTimerGate
+from .transfer import TransferQueueProcessor
+
+__all__ = [
+    "QueueAckManager",
+    "QueueProcessorBase",
+    "TimerQueueProcessor",
+    "LocalTimerGate",
+    "RemoteTimerGate",
+    "TransferQueueProcessor",
+]

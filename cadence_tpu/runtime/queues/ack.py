@@ -1,0 +1,63 @@
+"""Ordered ack levels over out-of-order task completion.
+
+Reference: /root/reference/service/history/queueAckMgr.go — tasks are
+read in order, complete in any order; the ack level advances over the
+longest finished prefix and is checkpointed into shardInfo.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+
+class QueueAckManager:
+    def __init__(
+        self,
+        ack_level,
+        update_shard_ack: Optional[Callable[[object], None]] = None,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.ack_level = ack_level  # int task_id or (ts, task_id) for timers
+        self.read_level = ack_level
+        self._outstanding: Dict[object, bool] = {}  # key → done
+        self._update_shard_ack = update_shard_ack
+
+    def add(self, key) -> bool:
+        """Register a read task; False if already outstanding (dup read)."""
+        with self._lock:
+            if key in self._outstanding:
+                return False
+            self._outstanding[key] = False
+            if key > self.read_level:
+                self.read_level = key
+            return True
+
+    def complete(self, key) -> None:
+        with self._lock:
+            if key in self._outstanding:
+                self._outstanding[key] = True
+
+    def update_ack_level(self):
+        """Advance over the finished prefix; checkpoint to the shard
+        only when the level actually moved."""
+        with self._lock:
+            before = self.ack_level
+            for key in sorted(self._outstanding):
+                if not self._outstanding[key]:
+                    break
+                del self._outstanding[key]
+                self.ack_level = key
+            level = self.ack_level
+        if level != before and self._update_shard_ack is not None:
+            self._update_shard_ack(level)
+        return level
+
+    def set_read_level(self, level) -> None:
+        with self._lock:
+            if level > self.read_level:
+                self.read_level = level
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._outstanding)

@@ -1,0 +1,123 @@
+"""Queue processor pump: batched reads → worker pool → ordered acks.
+
+Reference: /root/reference/service/history/queueProcessor.go:160-257
+(processBatch + pump), taskProcessor.go:119-313 (worker pool with
+per-task retry). The pump wakes on notify or poll interval, reads a
+batch past the read level, hands tasks to the pool, and periodically
+checkpoints the ack level into shardInfo.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+from cadence_tpu.runtime.api import EntityNotExistsServiceError
+from cadence_tpu.utils.log import get_logger
+
+from .ack import QueueAckManager
+
+_TASK_RETRY_COUNT = 3
+
+
+class QueueProcessorBase:
+    def __init__(
+        self,
+        name: str,
+        ack: QueueAckManager,
+        read_batch: Callable[[object, int], List[object]],
+        process_task: Callable[[object], None],
+        complete_task: Callable[[object], None],
+        task_key: Callable[[object], object],
+        worker_count: int = 4,
+        batch_size: int = 64,
+        poll_interval_s: float = 0.05,
+    ) -> None:
+        self.name = name
+        self.ack = ack
+        self._read_batch = read_batch
+        self._process_task = process_task
+        self._complete_task = complete_task
+        self._task_key = task_key
+        self._batch_size = batch_size
+        self._poll_interval = poll_interval_s
+        self._log = get_logger(f"cadence_tpu.queue.{name}")
+        self._notify = threading.Event()
+        self._stopped = threading.Event()
+        self._pool = ThreadPoolExecutor(
+            max_workers=worker_count, thread_name_prefix=f"{name}-worker"
+        )
+        self._pump_thread = threading.Thread(
+            target=self._pump, name=f"{name}-pump", daemon=True
+        )
+
+    def start(self) -> None:
+        self._pump_thread.start()
+
+    def notify(self) -> None:
+        self._notify.set()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._notify.set()
+        self._pool.shutdown(wait=False)
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Wait until no tasks are outstanding (for tests/shutdown)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.ack.outstanding() == 0 and not self._notify.is_set():
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- pump ----------------------------------------------------------
+
+    def _pump(self) -> None:
+        while not self._stopped.is_set():
+            self._notify.wait(timeout=self._poll_interval)
+            self._notify.clear()
+            if self._stopped.is_set():
+                return
+            try:
+                self._process_batch()
+            except Exception:
+                self._log.exception(f"queue {self.name} batch failed")
+            self.ack.update_ack_level()
+
+    def _process_batch(self) -> None:
+        while not self._stopped.is_set():
+            batch = self._read_batch(self.ack.read_level, self._batch_size)
+            if not batch:
+                return
+            for task in batch:
+                key = self._task_key(task)
+                if not self.ack.add(key):
+                    continue  # already outstanding
+                self._pool.submit(self._run_task, task, key)
+            if len(batch) < self._batch_size:
+                return
+
+    def _run_task(self, task, key) -> None:
+        for attempt in range(_TASK_RETRY_COUNT):
+            if self._stopped.is_set():
+                return
+            try:
+                self._process_task(task)
+                break
+            except EntityNotExistsServiceError:
+                break  # stale task: workflow/decision moved on
+            except Exception:
+                if attempt == _TASK_RETRY_COUNT - 1:
+                    self._log.exception(
+                        f"queue {self.name} task {key} dropped after "
+                        f"{_TASK_RETRY_COUNT} attempts"
+                    )
+        try:
+            self._complete_task(task)
+        except Exception:
+            self._log.exception(f"queue {self.name} complete({key}) failed")
+        self.ack.complete(key)

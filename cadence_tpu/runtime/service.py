@@ -1,0 +1,98 @@
+"""History service assembly: controller + engines + queue processors.
+
+Reference: /root/reference/service/history/service.go + handler.go —
+the history service owns a shard controller whose per-shard engines are
+wired to transfer/timer queue processors, a matching client for task
+pushes, and a history client for cross-shard workflow calls.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from cadence_tpu.utils.clock import TimeSource
+from cadence_tpu.utils.log import get_logger
+
+from .controller import ShardController, _ShardHandle
+from .domains import DomainCache
+from .engine.engine import HistoryEngine
+from .membership import Monitor
+from .persistence.interfaces import PersistenceBundle
+from .queues import TimerQueueProcessor, TransferQueueProcessor
+from .shard import ShardContext
+
+
+class HistoryService:
+    """One history host: all shards this host owns, fully wired."""
+
+    def __init__(
+        self,
+        num_shards: int,
+        persistence: PersistenceBundle,
+        domain_cache: DomainCache,
+        monitor: Monitor,
+        time_source: Optional[TimeSource] = None,
+        queue_worker_count: int = 4,
+    ) -> None:
+        self.persistence = persistence
+        self.domains = domain_cache
+        self.monitor = monitor
+        self._time = time_source
+        self._queue_workers = queue_worker_count
+        self._log = get_logger(
+            "cadence_tpu.history.service", host=monitor.self_identity
+        )
+        # late-bound clients (wire() resolves the construction cycle:
+        # processors need clients; clients need the controller)
+        self.matching_client = None
+        self.history_client = None
+        self.controller = ShardController(
+            num_shards, persistence, domain_cache, monitor,
+            engine_factory=self._build_shard, time_source=time_source,
+        )
+
+    def wire(self, matching_client, history_client) -> "HistoryService":
+        self.matching_client = matching_client
+        self.history_client = history_client
+        return self
+
+    def start(self) -> None:
+        if self.matching_client is None or self.history_client is None:
+            raise RuntimeError("HistoryService.wire() must be called first")
+        self.controller.acquire_shards()
+
+    def stop(self) -> None:
+        self.controller.stop()
+
+    # -- per-shard assembly --------------------------------------------
+
+    def _build_shard(self, shard: ShardContext) -> _ShardHandle:
+        engine = HistoryEngine(shard, self.domains)
+        transfer = TransferQueueProcessor(
+            shard, engine, self.matching_client, self.history_client,
+            worker_count=self._queue_workers,
+        )
+        timer = TimerQueueProcessor(
+            shard, engine, matching=self.matching_client,
+            worker_count=self._queue_workers,
+        )
+        engine._task_notifier = transfer.notify
+        engine._timer_notifier = timer.notify
+        transfer.start()
+        timer.start()
+        return _ShardHandle(shard, engine, [transfer, timer])
+
+    # -- introspection -------------------------------------------------
+
+    def describe(self) -> dict:
+        return self.controller.describe()
+
+    def drain_queues(self, timeout_s: float = 10.0) -> bool:
+        """Wait until every owned shard's queues are quiescent (tests)."""
+        ok = True
+        with self.controller._lock:
+            handles = list(self.controller._handles.values())
+        for handle in handles:
+            for p in handle.processors:
+                ok = p.drain(timeout_s) and ok
+        return ok

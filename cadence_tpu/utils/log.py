@@ -59,6 +59,10 @@ class Logger:
     def error(self, msg: str, **tags: Any) -> None:
         self._log.error(self._fmt(msg, tags))
 
+    def exception(self, msg: str, **tags: Any) -> None:
+        """error + current exception traceback."""
+        self._log.exception(self._fmt(msg, tags))
+
 
 def get_logger(name: str = "cadence_tpu", **tags: Any) -> Logger:
     return Logger(name, tags)
