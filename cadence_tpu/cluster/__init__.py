@@ -1,0 +1,9 @@
+"""Multi-cluster topology metadata.
+
+Reference: common/cluster/metadata.go (failover version arithmetic,
+master/current cluster, per-cluster info).
+"""
+
+from .metadata import ClusterInformation, ClusterMetadata, TEST_CLUSTER_METADATA
+
+__all__ = ["ClusterInformation", "ClusterMetadata", "TEST_CLUSTER_METADATA"]

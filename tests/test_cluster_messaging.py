@@ -1,0 +1,127 @@
+"""Cluster metadata arithmetic + message bus semantics."""
+
+import threading
+
+import pytest
+
+from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+from cadence_tpu.cluster.metadata import EMPTY_VERSION
+from cadence_tpu.messaging import MessageBus
+
+
+@pytest.fixture
+def meta():
+    return ClusterMetadata(
+        failover_version_increment=10,
+        master_cluster_name="active",
+        current_cluster_name="standby",
+        cluster_info={
+            "active": ClusterInformation(initial_failover_version=1),
+            "standby": ClusterInformation(initial_failover_version=2),
+        },
+    )
+
+
+class TestClusterMetadata:
+    def test_identity(self, meta):
+        assert meta.current_cluster_name == "standby"
+        assert meta.master_cluster_name == "active"
+        assert not meta.is_master_cluster
+        assert meta.enabled_remote_clusters() == ["active"]
+
+    def test_next_failover_version_moves_strictly_up(self, meta):
+        # from active's v1, failover to standby → next standby-owned version > 1
+        assert meta.next_failover_version("standby", 1) == 2
+        assert meta.next_failover_version("active", 2) == 11
+        assert meta.next_failover_version("active", 11) == 11
+        assert meta.next_failover_version("standby", 11) == 12
+        assert meta.next_failover_version("standby", 12) == 12
+
+    def test_version_to_cluster(self, meta):
+        assert meta.cluster_name_for_failover_version(1) == "active"
+        assert meta.cluster_name_for_failover_version(21) == "active"
+        assert meta.cluster_name_for_failover_version(2) == "standby"
+        assert meta.cluster_name_for_failover_version(32) == "standby"
+        assert meta.cluster_name_for_failover_version(EMPTY_VERSION) == "standby"
+        with pytest.raises(ValueError):
+            meta.cluster_name_for_failover_version(3)
+
+    def test_same_cluster_check(self, meta):
+        assert meta.is_version_from_same_cluster(1, 11)
+        assert not meta.is_version_from_same_cluster(1, 12)
+
+    def test_rejects_duplicate_initial_versions(self):
+        with pytest.raises(ValueError):
+            ClusterMetadata(
+                cluster_info={
+                    "a": ClusterInformation(initial_failover_version=1),
+                    "b": ClusterInformation(initial_failover_version=1),
+                },
+                master_cluster_name="a",
+                current_cluster_name="a",
+            )
+
+
+class TestMessageBus:
+    def test_publish_consume_ack(self):
+        bus = MessageBus()
+        p = bus.new_producer("t")
+        c = bus.new_consumer("t", "g1")
+        p.publish("k1", {"n": 1})
+        p.publish("k2", {"n": 2})
+        m1 = c.poll()
+        m2 = c.poll()
+        assert (m1.key, m2.key) == ("k1", "k2")
+        c.ack(m1)
+        c.ack(m2)
+        assert c.poll() is None
+
+    def test_independent_groups(self):
+        bus = MessageBus()
+        bus.publish("t", "k", 1)
+        c1 = bus.new_consumer("t", "g1")
+        c2 = bus.new_consumer("t", "g2")
+        assert c1.poll().value == 1
+        assert c2.poll().value == 1
+
+    def test_nack_redelivers_then_dlq(self):
+        bus = MessageBus(max_redelivery=2)
+        bus.publish("t", "k", "v")
+        c = bus.new_consumer("t", "g")
+        for _ in range(3):  # initial + 2 redeliveries
+            m = c.poll()
+            assert m is not None
+            c.nack(m)
+        assert c.poll() is None
+        dlq = bus.dlq_messages("t")
+        assert len(dlq) == 1 and dlq[0].key == "k"
+
+    def test_drain_with_failing_handler(self):
+        bus = MessageBus(max_redelivery=1)
+        for i in range(4):
+            bus.publish("t", f"k{i}", i)
+        c = bus.new_consumer("t", "g")
+
+        def handler(msg):
+            if msg.value == 2 and msg.redelivery_count == 0:
+                raise RuntimeError("flaky")
+
+        # redelivery happens inside the same drain: 4 originals, one retried
+        ok = c.drain(handler)
+        assert ok == 4
+        assert c.drain(handler) == 0
+        assert bus.dlq_messages("t") == []
+
+    def test_blocking_poll_wakes_on_publish(self):
+        bus = MessageBus()
+        c = bus.new_consumer("t", "g")
+        got = []
+
+        def consume():
+            got.append(c.poll(timeout=5.0))
+
+        th = threading.Thread(target=consume)
+        th.start()
+        bus.publish("t", "k", 42)
+        th.join(timeout=5.0)
+        assert got and got[0].value == 42
