@@ -77,11 +77,18 @@ def register_domain(
     is_global: bool = False,
     clusters: Optional[List[str]] = None,
     active_cluster: str = "active",
+    domain_id: Optional[str] = None,
+    failover_version: int = 0,
 ) -> str:
-    """Domain registration (reference: domain/handler.go RegisterDomain)."""
+    """Domain registration (reference: domain/handler.go RegisterDomain).
+
+    ``domain_id``/``failover_version`` are set explicitly when the domain
+    record is replicated from another cluster — the ID must be identical
+    cluster-wide (domainReplicationTaskHandler.go)."""
     rec = DomainRecord(
         info=DomainInfo(
-            id=str(uuid.uuid4()), name=name, description=description
+            id=domain_id or str(uuid.uuid4()), name=name,
+            description=description,
         ),
         config=DomainConfig(retention_days=retention_days),
         replication_config=DomainReplicationConfig(
@@ -89,5 +96,6 @@ def register_domain(
             clusters=list(clusters or [active_cluster]),
         ),
         is_global=is_global,
+        failover_version=failover_version,
     )
     return metadata.create_domain(rec)

@@ -15,6 +15,7 @@ from typing import List, Optional, Tuple
 from cadence_tpu.core.active_transaction import TransactionResult
 from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.core.mutable_state import MutableState
+from cadence_tpu.core.tasks import ReplicationTask
 
 from ..persistence.records import (
     BranchToken,
@@ -90,9 +91,32 @@ class WorkflowExecutionContext:
                 if not t.run_id:
                     t.run_id = run_id
 
+    def _replication_tasks(
+        self, ms: MutableState, events: List[HistoryEvent],
+        new_run_branch: bytes = b"",
+    ) -> List[ReplicationTask]:
+        """Active-side replication task for one persisted event batch.
+
+        Reference: mutableStateBuilder closeTransactionHandleWorkflow-
+        ReplicationTask — global domains (version histories present) emit
+        one HistoryReplicationTask per transaction batch so the
+        replicator queue can ship it to remote clusters."""
+        if ms.version_histories is None or not events:
+            return []
+        return [
+            ReplicationTask(
+                first_event_id=events[0].event_id,
+                next_event_id=events[-1].event_id + 1,
+                version=events[0].version,
+                branch_token=ms.execution_info.branch_token,
+                new_run_branch_token=new_run_branch,
+            )
+        ]
+
     def _snapshot_of(
         self, ms: MutableState, result_tasks: TransactionResult,
         new_run: bool = False,
+        replication_tasks: Optional[List[ReplicationTask]] = None,
     ) -> WorkflowSnapshot:
         ei = ms.execution_info
         return WorkflowSnapshot(
@@ -112,6 +136,7 @@ class WorkflowExecutionContext:
                 if new_run
                 else result_tasks.timer_tasks
             ),
+            replication_tasks=replication_tasks or [],
         )
 
     def create_workflow(
@@ -125,17 +150,24 @@ class WorkflowExecutionContext:
         history = self.shard.persistence.history
         branch = history.new_history_branch(tree_id=self.run_id)
         ms.execution_info.branch_token = branch.to_json().encode()
+        if ms.version_histories is not None:
+            ms.version_histories.get_current_version_history().branch_token = (
+                ms.execution_info.branch_token
+            )
         size = self._append_events(branch, result.events)
         ms.execution_info.history_size = size
-        self.shard.assign_task_ids(result.transfer_tasks, result.timer_tasks)
+        repl = self._replication_tasks(ms, result.events)
+        self.shard.assign_task_ids(
+            result.transfer_tasks, result.timer_tasks, repl
+        )
         self._stamp_identity(
-            self.run_id, result.transfer_tasks, result.timer_tasks
+            self.run_id, result.transfer_tasks, result.timer_tasks, repl
         )
         self.shard.persistence.execution.create_workflow_execution(
             self.shard.shard_id,
             self.shard.range_id,
             mode,
-            self._snapshot_of(ms, result),
+            self._snapshot_of(ms, result, replication_tasks=repl),
             prev_run_id=prev_run_id,
         )
         self._ms = ms
@@ -149,12 +181,10 @@ class WorkflowExecutionContext:
         if result.events:
             size = self._append_events(self.branch_token(ms), result.events)
         ms.execution_info.history_size += size
-        self.shard.assign_task_ids(result.transfer_tasks, result.timer_tasks)
-        self._stamp_identity(
-            self.run_id, result.transfer_tasks, result.timer_tasks
-        )
 
         new_snapshot = None
+        new_run_id = ""
+        new_run_branch = b""
         if result.new_run_ms is not None:
             new_ms = result.new_run_ms
             new_run_id = result.events[-1].attributes.get(
@@ -165,6 +195,10 @@ class WorkflowExecutionContext:
                 tree_id=new_run_id
             )
             new_ms.execution_info.branch_token = branch.to_json().encode()
+            if new_ms.version_histories is not None:
+                new_ms.version_histories.get_current_version_history(
+                ).branch_token = new_ms.execution_info.branch_token
+            new_run_branch = new_ms.execution_info.branch_token
             new_size = self._append_events(branch, result.new_run_events)
             new_ms.execution_info.history_size = new_size
             self.shard.assign_task_ids(
@@ -177,11 +211,18 @@ class WorkflowExecutionContext:
             )
             new_snapshot = self._snapshot_of(new_ms, result, new_run=True)
 
+        repl = self._replication_tasks(ms, result.events, new_run_branch)
+        self.shard.assign_task_ids(
+            result.transfer_tasks, result.timer_tasks, repl
+        )
+        self._stamp_identity(
+            self.run_id, result.transfer_tasks, result.timer_tasks, repl
+        )
         self.shard.persistence.execution.update_workflow_execution(
             self.shard.shard_id,
             self.shard.range_id,
             self._condition,
-            self._snapshot_of(ms, result),
+            self._snapshot_of(ms, result, replication_tasks=repl),
             new_snapshot=new_snapshot,
         )
         self._condition = ms.next_event_id

@@ -887,3 +887,86 @@ class HistoryEngine:
             )
 
         return self._update_workflow(domain_id, workflow_id, run_id, action)
+
+    # -- replication entry points -------------------------------------
+    # Reference: historyEngine.go:1914 ReplicateEventsV2 →
+    # nDCHistoryReplicator.ApplyEvents; replicatorQueueProcessor serves
+    # GetReplicationMessages.
+
+    @property
+    def ndc_replicator(self):
+        if getattr(self, "_ndc_replicator", None) is None:
+            from ..replication.ndc import NDCHistoryReplicator
+
+            cluster_meta = getattr(self, "cluster_metadata", None)
+
+            def is_active_locally(domain_id: str) -> bool:
+                if cluster_meta is None:
+                    return True
+                try:
+                    rec = self.domains.get_by_id(domain_id)
+                except Exception:
+                    return False
+                return (
+                    rec.replication_config.active_cluster_name
+                    == cluster_meta.current_cluster_name
+                )
+
+            self._ndc_replicator = NDCHistoryReplicator(
+                self.shard, self.domains, self.cache,
+                is_active_locally=is_active_locally,
+                task_notifier=self._task_notifier,
+                timer_notifier=self._timer_notifier,
+            )
+        return self._ndc_replicator
+
+    @property
+    def replicator_queue(self):
+        if getattr(self, "_replicator_queue", None) is None:
+            from ..replication.replicator_queue import ReplicatorQueueProcessor
+
+            self._replicator_queue = ReplicatorQueueProcessor(self.shard)
+        return self._replicator_queue
+
+    def replicate_events_v2(self, task) -> None:
+        """Apply one replicated event batch (HistoryTaskV2)."""
+        self.ndc_replicator.apply_events(task)
+
+    def get_replication_messages(self, cluster: str, last_retrieved_id: int):
+        return self.replicator_queue.get_replication_messages(
+            cluster, last_retrieved_id
+        )
+
+    def get_workflow_history_raw(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        start_event_id: int, end_event_id: int,
+    ):
+        """Raw history + version-history items for re-replication
+        (reference: adminHandler GetWorkflowExecutionRawHistoryV2)."""
+        from ..persistence.records import BranchToken
+
+        resp = self.shard.persistence.execution.get_workflow_execution(
+            self.shard.shard_id, domain_id, workflow_id, run_id
+        )
+        snap = resp.snapshot or {}
+        vh_dict = snap.get("version_histories") or {}
+        histories = vh_dict.get("histories", [])
+        current = (
+            histories[vh_dict.get("current_index", 0)]
+            if histories
+            else {"items": [], "branch_token": ""}
+        )
+        items = [
+            {"event_id": e, "version": v} for e, v in current.get("items", [])
+        ]
+        raw = snap.get("execution_info", {}).get("branch_token", "")
+        token_str = (
+            current.get("branch_token") or raw
+        )
+        if isinstance(token_str, bytes):
+            token_str = token_str.decode()
+        branch = BranchToken.from_json(token_str)
+        batches, _ = self.shard.persistence.history.read_history_branch(
+            branch, start_event_id, end_event_id
+        )
+        return batches, items

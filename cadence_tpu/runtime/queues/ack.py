@@ -61,3 +61,12 @@ class QueueAckManager:
     def outstanding(self) -> int:
         with self._lock:
             return len(self._outstanding)
+
+    def abandon(self, key) -> None:
+        """Un-register a task WITHOUT completing it: the pump will
+        re-read it later (deferred standby tasks). The read level rewinds
+        to the ack level so nothing between ack and read is skipped;
+        still-outstanding keys dedup via add()."""
+        with self._lock:
+            self._outstanding.pop(key, None)
+            self.read_level = self.ack_level

@@ -1,0 +1,40 @@
+"""Task allocator: should THIS cluster process a task actively?
+
+Reference: service/history/taskAllocator.go — during/after failover,
+each queue task is checked against the domain's active cluster; a
+standby cluster must not fire timers or dispatch tasks for a domain it
+is passive for (the active side does; the standby's state converges via
+replication instead).
+"""
+
+from __future__ import annotations
+
+
+class TaskAllocator:
+    def __init__(self, domains, cluster_metadata=None) -> None:
+        self.domains = domains
+        self.cluster_metadata = cluster_metadata
+
+    def should_process(self, domain_id: str) -> bool:
+        """True if the task's domain is active here (or local-only, or
+        the cluster is single-cluster)."""
+        if self.cluster_metadata is None:
+            return True
+        try:
+            rec = self.domains.get_by_id(domain_id)
+        except Exception:
+            return True  # unknown domain: let the handler surface it
+        if not rec.is_global:
+            return True
+        return (
+            rec.replication_config.active_cluster_name
+            == self.cluster_metadata.current_cluster_name
+        )
+
+
+class DeferTask(Exception):
+    """Raised by a processor handler when the task must NOT be executed
+    or completed now (domain is passive here). The runner abandons the
+    task back to the queue after a standby delay — mirroring the
+    reference's standby task processors, which hold tasks until the
+    domain fails over or replication catches up."""

@@ -17,6 +17,7 @@ from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
+from .allocator import DeferTask
 
 _TASK_RETRY_COUNT = 3
 
@@ -101,6 +102,16 @@ class QueueProcessorBase:
             if len(batch) < self._batch_size:
                 return
 
+    _STANDBY_RETRY_DELAY_S = 0.5
+
+    def _defer(self, key) -> None:
+        """Release a passive-domain task back to the queue after a
+        standby delay (the reference's standby processors hold tasks
+        until failover or replication catches up)."""
+        t = threading.Timer(self._STANDBY_RETRY_DELAY_S, self.ack.abandon, [key])
+        t.daemon = True
+        t.start()
+
     def _run_task(self, task, key) -> None:
         for attempt in range(_TASK_RETRY_COUNT):
             if self._stopped.is_set():
@@ -108,6 +119,9 @@ class QueueProcessorBase:
             try:
                 self._process_task(task)
                 break
+            except DeferTask:
+                self._defer(key)
+                return
             except EntityNotExistsServiceError:
                 break  # stale task: workflow/decision moved on
             except Exception:

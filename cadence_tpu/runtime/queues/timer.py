@@ -23,6 +23,7 @@ from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
+from .allocator import DeferTask, TaskAllocator
 from .timer_gate import LocalTimerGate
 
 _TIMEOUT_REASON = "cadenceInternal:Timeout"
@@ -48,6 +49,9 @@ class TimerQueueProcessor:
             update_shard_ack=lambda lvl: shard.update_timer_ack_level(lvl[0]),
         )
         self.gate = LocalTimerGate(time_source=shard.time_source)
+        self._allocator = TaskAllocator(
+            engine.domains, getattr(engine, "cluster_metadata", None)
+        )
         self._stopped = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=worker_count, thread_name_prefix=f"timer-{shard.shard_id}"
@@ -116,6 +120,14 @@ class TimerQueueProcessor:
 
     _TASK_RETRY_COUNT = 3
 
+    _STANDBY_RETRY_DELAY_S = 0.5
+
+    def _defer(self, key) -> None:
+        """Release the task back to the queue after a standby delay."""
+        t = threading.Timer(self._STANDBY_RETRY_DELAY_S, self.ack.abandon, [key])
+        t.daemon = True
+        t.start()
+
     def _run_task(self, task: TimerTask, key) -> None:
         for attempt in range(self._TASK_RETRY_COUNT):
             if self._stopped.is_set():
@@ -123,6 +135,9 @@ class TimerQueueProcessor:
             try:
                 self._process(task)
                 break
+            except DeferTask:
+                self._defer(key)
+                return
             except EntityNotExistsServiceError:
                 break  # workflow gone / state moved on: stale timer
             except Exception:
@@ -142,6 +157,10 @@ class TimerQueueProcessor:
     # -- handlers ------------------------------------------------------
 
     def _process(self, task: TimerTask) -> None:
+        if not self._allocator.should_process(task.domain_id):
+            # passive domain: hold the task; it fires here only after a
+            # failover makes this cluster active
+            raise DeferTask(task.domain_id)
         handler = {
             TimerTaskType.UserTimer: self._process_user_timer,
             TimerTaskType.ActivityTimeout: self._process_activity_timeout,

@@ -32,6 +32,7 @@ from cadence_tpu.runtime.persistence.records import VisibilityRecord
 from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
+from .allocator import DeferTask, TaskAllocator
 from .base import QueueProcessorBase
 
 # close status → the child-close event type recorded in the parent
@@ -67,6 +68,9 @@ class TransferQueueProcessor(QueueProcessorBase):
         self._tlog = get_logger(
             "cadence_tpu.queue.transfer", shard=shard.shard_id
         )
+        self._allocator = TaskAllocator(
+            engine.domains, getattr(engine, "cluster_metadata", None)
+        )
         ack = QueueAckManager(
             shard.get_transfer_ack_level(),
             update_shard_ack=shard.update_transfer_ack_level,
@@ -89,6 +93,9 @@ class TransferQueueProcessor(QueueProcessorBase):
     # -- dispatch ------------------------------------------------------
 
     def _process(self, task: TransferTask) -> None:
+        if not self._allocator.should_process(task.domain_id):
+            # passive domain: hold until failover makes this cluster active
+            raise DeferTask(task.domain_id)
         handler = {
             TransferTaskType.DecisionTask: self._process_decision,
             TransferTaskType.ActivityTask: self._process_activity,

@@ -1,0 +1,31 @@
+"""Cross-cluster replication plane.
+
+Reference: service/history/replicatorQueueProcessor.go (emit side),
+replicationTaskFetcher.go / replicationTaskProcessor.go (consume side),
+nDCHistoryReplicator.go + nDCBranchMgr / nDCConflictResolver /
+nDCStateRebuilder / nDCEventsReapplier / nDCTransactionMgr (apply),
+common/xdc/historyRereplicator.go (gap fill).
+"""
+
+from .messages import (
+    HistoryTaskV2,
+    ReplicationMessages,
+    RetryTaskV2Error,
+)
+from .replicator_queue import ReplicatorQueueProcessor
+from .rebuilder import StateRebuilder
+from .ndc import NDCHistoryReplicator
+from .processor import ReplicationTaskFetcher, ReplicationTaskProcessor
+from .rereplicator import HistoryRereplicator
+
+__all__ = [
+    "HistoryTaskV2",
+    "ReplicationMessages",
+    "RetryTaskV2Error",
+    "ReplicatorQueueProcessor",
+    "StateRebuilder",
+    "NDCHistoryReplicator",
+    "ReplicationTaskFetcher",
+    "ReplicationTaskProcessor",
+    "HistoryRereplicator",
+]

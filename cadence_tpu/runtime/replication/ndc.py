@@ -1,0 +1,447 @@
+"""NDC multi-master history replication: apply remote event batches.
+
+Reference: service/history/nDCHistoryReplicator.go:158 (ApplyEvents) and
+its satellites — nDCBranchMgr.go (LCA branch selection / fork),
+nDCConflictResolver.go:65 (rebuild-at-branch-point via state rebuilder),
+nDCTransactionMgr*.go (create/update as current vs zombie),
+nDCEventsReapplier.go (reapply signals from stale branches).
+
+The control flow is host-side Python; the replay inside creation,
+continuation, and rebuild all goes through the shared StateBuilder whose
+semantics are differential-tested against the TPU kernel — so a
+replication storm can be drained through ``StateRebuilder.rebuild_many``
+(one device scan for the whole backlog) without changing this module's
+contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.enums import EventType, WorkflowState
+from cadence_tpu.core.mutable_state import MutableState
+from cadence_tpu.core.state_builder import StateBuilder
+from cadence_tpu.core.tasks import ReplicationTask
+from cadence_tpu.core.version_history import (
+    VersionHistories,
+    VersionHistory,
+    VersionHistoryError,
+    VersionHistoryItem,
+)
+
+from ..persistence.errors import EntityNotExistsError
+from ..persistence.records import (
+    BranchToken,
+    CreateWorkflowMode,
+    WorkflowSnapshot,
+)
+from ..shard import ShardContext
+from .messages import HistoryTaskV2, RetryTaskV2Error
+from .rebuilder import RebuildRequest, StateRebuilder
+
+
+def _incoming_history(task: HistoryTaskV2) -> VersionHistory:
+    return VersionHistory(
+        items=[
+            VersionHistoryItem(it["event_id"], it["version"])
+            for it in task.version_history_items
+        ]
+    )
+
+
+class NDCHistoryReplicator:
+    """Applies HistoryTaskV2 batches to the local shard."""
+
+    def __init__(
+        self,
+        shard: ShardContext,
+        domains,
+        cache,
+        rebuilder: Optional[StateRebuilder] = None,
+        is_active_locally=None,
+        task_notifier=lambda: None,
+        timer_notifier=lambda: None,
+    ) -> None:
+        self.shard = shard
+        self.domains = domains
+        self.cache = cache
+        self.rebuilder = rebuilder or StateRebuilder(
+            shard.persistence.history,
+            domain_resolver=self._resolve_domain,
+        )
+        # whether this cluster is currently active for a domain (drives
+        # signal reapplication; standby clusters never mint events)
+        self._is_active_locally = is_active_locally or (lambda domain_id: False)
+        self._task_notifier = task_notifier
+        self._timer_notifier = timer_notifier
+
+    def _resolve_domain(self, name: str) -> str:
+        if not name:
+            return ""
+        try:
+            return self.domains.resolve(name).info.id
+        except Exception:
+            return name
+
+    # -- entry point ---------------------------------------------------
+
+    def apply_events(self, task: HistoryTaskV2) -> None:
+        if not task.events:
+            raise ValueError("replication task has no events")
+        ctx = self.cache.get_or_create(
+            task.domain_id, task.workflow_id, task.run_id
+        )
+        with ctx.lock:
+            try:
+                ms = ctx.load()
+            except EntityNotExistsError:
+                self._apply_for_new_workflow(ctx, task)
+                return
+            self._apply_for_existing(ctx, ms, task)
+
+    # -- creation path (nDCTransactionMgrForNewWorkflow) ---------------
+
+    def _apply_for_new_workflow(self, ctx, task: HistoryTaskV2) -> None:
+        if task.first_event_id != 1:
+            raise RetryTaskV2Error(
+                "workflow missing locally; need history from the start",
+                domain_id=task.domain_id,
+                workflow_id=task.workflow_id,
+                run_id=task.run_id,
+                start_event_id=0,
+                end_event_id=task.first_event_id,
+                end_event_version=task.version,
+            )
+        history = self.shard.persistence.history
+        branch = history.new_history_branch(tree_id=task.run_id)
+        history.append_history_nodes(
+            branch, task.events, transaction_id=self.shard.next_task_id()
+        )
+
+        ms = MutableState(domain_id=task.domain_id)
+        ms.version_histories = VersionHistories.new_empty()
+        sb = StateBuilder(ms, domain_resolver=self._resolve_domain)
+        sb.apply_events(
+            task.domain_id, "replication", task.workflow_id, task.run_id,
+            list(task.events),
+            list(task.new_run_events) or None,
+        )
+        ms.execution_info.branch_token = branch.to_json().encode()
+        ms.version_histories.get_current_version_history().branch_token = (
+            ms.execution_info.branch_token
+        )
+
+        mode, prev_run_id = self._create_mode(task)
+        snapshot = self._snapshot(
+            ms, sb.transfer_tasks, sb.timer_tasks, zombie=(
+                mode == CreateWorkflowMode.ZOMBIE
+            ),
+        )
+        self.shard.persistence.execution.create_workflow_execution(
+            self.shard.shard_id, self.shard.range_id, mode, snapshot,
+            prev_run_id=prev_run_id,
+        )
+        ctx._ms = ms
+        ctx._condition = ms.next_event_id
+        self._notify(sb)
+
+    def _create_mode(self, task: HistoryTaskV2) -> Tuple[int, str]:
+        """current-vs-zombie decision for a replication-created run."""
+        try:
+            cur = self.shard.persistence.execution.get_current_execution(
+                self.shard.shard_id, task.domain_id, task.workflow_id
+            )
+        except EntityNotExistsError:
+            return CreateWorkflowMode.BRAND_NEW, ""
+        if task.version >= cur.last_write_version and cur.state == int(
+            WorkflowState.Completed
+        ):
+            return CreateWorkflowMode.WORKFLOW_ID_REUSE, cur.run_id
+        # a running current run with a version >= ours keeps primacy
+        return CreateWorkflowMode.ZOMBIE, ""
+
+    # -- existing-workflow path ----------------------------------------
+
+    def _apply_for_existing(
+        self, ctx, ms: MutableState, task: HistoryTaskV2
+    ) -> None:
+        local = ms.version_histories
+        if local is None:
+            raise ValueError(
+                "replication target has no version histories (local domain?)"
+            )
+        incoming = _incoming_history(task)
+        try:
+            branch_index, lca_item = local.find_lca_index_and_item(incoming)
+        except VersionHistoryError:
+            raise RetryTaskV2Error(
+                "no common ancestor; resync from start",
+                domain_id=task.domain_id,
+                workflow_id=task.workflow_id,
+                run_id=task.run_id,
+                start_event_id=0,
+                end_event_id=task.first_event_id,
+                end_event_version=task.version,
+            )
+
+        branch_vh = local.get_version_history(branch_index)
+        last_local = branch_vh.last_item()
+
+        if branch_vh.is_lca_appendable(lca_item):
+            # incoming continues this branch
+            if task.next_event_id <= last_local.event_id + 1 and (
+                branch_vh.contains_item(
+                    VersionHistoryItem(task.next_event_id - 1, task.version)
+                )
+            ):
+                return  # duplicate batch — already applied
+            if task.first_event_id > last_local.event_id + 1:
+                raise RetryTaskV2Error(
+                    "missing intermediate events",
+                    domain_id=task.domain_id,
+                    workflow_id=task.workflow_id,
+                    run_id=task.run_id,
+                    start_event_id=last_local.event_id,
+                    start_event_version=last_local.version,
+                    end_event_id=task.first_event_id,
+                    end_event_version=task.version,
+                )
+        else:
+            # divergence: fork a new branch at the LCA
+            branch_index = self._fork_branch(
+                local, branch_index, lca_item, task
+            )
+            branch_vh = local.get_version_history(branch_index)
+            if task.first_event_id > lca_item.event_id + 1:
+                raise RetryTaskV2Error(
+                    "fork point behind incoming batch",
+                    domain_id=task.domain_id,
+                    workflow_id=task.workflow_id,
+                    run_id=task.run_id,
+                    start_event_id=lca_item.event_id,
+                    start_event_version=lca_item.version,
+                    end_event_id=task.first_event_id,
+                    end_event_version=task.version,
+                )
+
+        # conflict resolution: which branch becomes/stays current
+        if branch_index == local.current_index:
+            self._apply_to_current(ctx, ms, task, branch_vh)
+            return
+
+        current_vh = local.get_current_version_history()
+        if task.version > current_vh.last_item().version:
+            # incoming wins: rebuild state from the target branch tip,
+            # then continue applying on it as the new current
+            self._rebuild_and_apply(ctx, ms, task, branch_index)
+        else:
+            self._backfill_branch(ctx, ms, task, branch_index)
+
+    # -- branch manager ------------------------------------------------
+
+    def _fork_branch(
+        self,
+        local: VersionHistories,
+        base_index: int,
+        lca_item: VersionHistoryItem,
+        task: HistoryTaskV2,
+    ) -> int:
+        base_vh = local.get_version_history(base_index)
+        base_branch = BranchToken.from_json(base_vh.branch_token.decode())
+        forked = self.shard.persistence.history.fork_history_branch(
+            base_branch, lca_item.event_id + 1
+        )
+        new_vh = VersionHistory(
+            branch_token=forked.to_json().encode(),
+            items=[
+                it
+                for it in base_vh.items
+                if it.event_id <= lca_item.event_id
+            ]
+            or [lca_item],
+        )
+        # clamp the boundary item to the LCA event id
+        if new_vh.items[-1].event_id > lca_item.event_id:
+            new_vh.items[-1] = lca_item
+        _, new_index = local.add_version_history(new_vh)
+        # add_version_history may have flipped current; restore — the
+        # conflict resolver owns that decision
+        return new_index
+
+    # -- apply variants ------------------------------------------------
+
+    def _apply_to_current(
+        self, ctx, ms: MutableState, task: HistoryTaskV2,
+        branch_vh: VersionHistory,
+    ) -> None:
+        branch = BranchToken.from_json(
+            (branch_vh.branch_token or ms.execution_info.branch_token).decode()
+        )
+        self.shard.persistence.history.append_history_nodes(
+            branch, task.events, transaction_id=self.shard.next_task_id()
+        )
+        sb = StateBuilder(ms, domain_resolver=self._resolve_domain)
+        _, _, new_run_ms = sb.apply_events(
+            task.domain_id, "replication", task.workflow_id, task.run_id,
+            list(task.events),
+            list(task.new_run_events) or None,
+        )
+        if branch_vh.branch_token:
+            ms.execution_info.branch_token = branch_vh.branch_token
+
+        new_snapshot = None
+        if new_run_ms is not None and task.new_run_events:
+            new_snapshot = self._stage_new_run(new_run_ms, task)
+
+        snapshot = self._snapshot(ms, sb.transfer_tasks, sb.timer_tasks)
+        self.shard.persistence.execution.update_workflow_execution(
+            self.shard.shard_id, self.shard.range_id, ctx.condition,
+            snapshot, new_snapshot=new_snapshot,
+        )
+        ctx._ms = ms
+        ctx._condition = ms.next_event_id
+        self._notify(sb)
+
+    def _rebuild_and_apply(
+        self, ctx, ms: MutableState, task: HistoryTaskV2, branch_index: int
+    ) -> None:
+        """Reference nDCConflictResolver: the incoming version beats the
+        current branch → rebuild mutable state from the target branch,
+        flip current, then apply the batch on top."""
+        local = ms.version_histories
+        target_vh = local.get_version_history(branch_index)
+        req = RebuildRequest(
+            domain_id=task.domain_id,
+            workflow_id=task.workflow_id,
+            run_id=task.run_id,
+            branch_token=target_vh.branch_token,
+            next_event_id=target_vh.last_item().event_id + 1,
+        )
+        rebuilt, _, _ = self.rebuilder.rebuild(req)
+        # carry over the full set of branches; flip current
+        rebuilt.version_histories = local
+        local.current_index = branch_index
+        rebuilt.execution_info.run_id = task.run_id
+        rebuilt.execution_info.workflow_id = task.workflow_id
+        self._apply_to_current(ctx, rebuilt, task, target_vh)
+
+    def _backfill_branch(
+        self, ctx, ms: MutableState, task: HistoryTaskV2, branch_index: int
+    ) -> None:
+        """Events belong to a stale branch: persist them + the version-
+        history bookkeeping without touching workflow state."""
+        local = ms.version_histories
+        vh = local.get_version_history(branch_index)
+        branch = BranchToken.from_json(vh.branch_token.decode())
+        self.shard.persistence.history.append_history_nodes(
+            branch, task.events, transaction_id=self.shard.next_task_id()
+        )
+        for e in task.events:
+            vh.add_or_update_item(e.event_id, e.version)
+        snapshot = self._snapshot(ms, [], [])
+        self.shard.persistence.execution.update_workflow_execution(
+            self.shard.shard_id, self.shard.range_id, ctx.condition, snapshot,
+        )
+        ctx._ms = ms
+        ctx._condition = ms.next_event_id
+        # signals on the stale branch still matter to the live run
+        if self._is_active_locally(task.domain_id):
+            self._reapply_signals(ctx, ms, task.events)
+
+    # -- events reapplier (nDCEventsReapplier.go) ----------------------
+
+    def _reapply_signals(
+        self, ctx, ms: MutableState, events: List[HistoryEvent]
+    ) -> None:
+        signals = [
+            e for e in events
+            if e.event_type == EventType.WorkflowExecutionSignaled
+        ]
+        if not signals or not ms.is_workflow_execution_running():
+            return
+        from cadence_tpu.core.active_transaction import ActiveTransaction
+
+        txn = ActiveTransaction(
+            ms, ms.execution_info.domain_id, ms.execution_info.workflow_id,
+            ms.execution_info.run_id, ms.current_version,
+        )
+        now = self.shard.now()
+        for e in signals:
+            a = e.attributes
+            txn.add_workflow_execution_signaled(
+                a.get("signal_name", ""), a.get("input", b""),
+                a.get("identity", ""), now,
+            )
+        result = txn.close()
+        if result.events:
+            branch = BranchToken.from_json(
+                ms.execution_info.branch_token.decode()
+            )
+            self.shard.persistence.history.append_history_nodes(
+                branch, result.events,
+                transaction_id=self.shard.next_task_id(),
+            )
+        # with a decision in flight the signals land in buffered_events;
+        # they reach history when the decision completes
+        snapshot = self._snapshot(
+            ms, result.transfer_tasks, result.timer_tasks
+        )
+        self.shard.persistence.execution.update_workflow_execution(
+            self.shard.shard_id, self.shard.range_id, ctx.condition, snapshot,
+        )
+        ctx._condition = ms.next_event_id
+
+    # -- persistence helpers -------------------------------------------
+
+    def _snapshot(
+        self, ms: MutableState, transfer, timer, zombie: bool = False
+    ) -> WorkflowSnapshot:
+        ei = ms.execution_info
+        for t in list(transfer) + list(timer):
+            if not t.domain_id:
+                t.domain_id = ei.domain_id
+            if not t.workflow_id:
+                t.workflow_id = ei.workflow_id
+            if not t.run_id:
+                t.run_id = ei.run_id
+        self.shard.assign_task_ids(transfer, timer)
+        return WorkflowSnapshot(
+            domain_id=ei.domain_id,
+            workflow_id=ei.workflow_id,
+            run_id=ei.run_id,
+            snapshot=ms.snapshot(),
+            next_event_id=ms.next_event_id,
+            last_write_version=ms.current_version,
+            transfer_tasks=list(transfer),
+            timer_tasks=list(timer),
+        )
+
+    def _stage_new_run(
+        self, new_run_ms: MutableState, task: HistoryTaskV2
+    ) -> WorkflowSnapshot:
+        new_run_id = task.new_run_id or task.events[-1].attributes.get(
+            "new_execution_run_id", ""
+        )
+        new_run_ms.execution_info.run_id = new_run_id
+        history = self.shard.persistence.history
+        branch = history.new_history_branch(tree_id=new_run_id)
+        new_run_ms.execution_info.branch_token = branch.to_json().encode()
+        if new_run_ms.version_histories is not None:
+            new_run_ms.version_histories.get_current_version_history(
+            ).branch_token = new_run_ms.execution_info.branch_token
+        history.append_history_nodes(
+            branch, task.new_run_events,
+            transaction_id=self.shard.next_task_id(),
+        )
+        from cadence_tpu.core.task_refresher import refresh_tasks
+
+        transfer, timer = refresh_tasks(new_run_ms)
+        return self._snapshot(new_run_ms, transfer, timer)
+
+    def _notify(self, sb: StateBuilder) -> None:
+        if sb.transfer_tasks:
+            self._task_notifier()
+        if sb.timer_tasks:
+            self._timer_notifier()

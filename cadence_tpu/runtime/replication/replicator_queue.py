@@ -1,0 +1,150 @@
+"""Replicator queue: hydrate persisted replication tasks into messages.
+
+Reference: service/history/replicatorQueueProcessor.go — reads the
+shard's replication task queue, loads the event batch each task covers
+from its history branch (getHistoryTaskV2 → ReadHistoryBranchByBatch),
+attaches the version-history items, and serves them to remote pollers
+via GetReplicationMessages (pull model). Acking completes tasks up to
+the remote's last-processed ID.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.tasks import ReplicationTask
+
+from ..persistence.errors import EntityNotExistsError
+from ..persistence.records import BranchToken
+from ..shard import ShardContext
+from .messages import HistoryTaskV2, ReplicationMessages
+
+
+class ReplicatorQueueProcessor:
+    """Per-shard emit side of replication."""
+
+    def __init__(self, shard: ShardContext, batch_size: int = 100) -> None:
+        self.shard = shard
+        self.batch_size = batch_size
+        self._lock = threading.Lock()
+        # last task id each remote cluster has confirmed processing
+        self._cluster_ack: Dict[str, int] = {}
+
+    # -- hydration ----------------------------------------------------
+
+    def _read_batch(
+        self, branch_token: bytes, first_event_id: int, next_event_id: int
+    ) -> List[HistoryEvent]:
+        if not branch_token:
+            return []
+        branch = BranchToken.from_json(branch_token.decode())
+        batches, _ = self.shard.persistence.history.read_history_branch(
+            branch, first_event_id, next_event_id
+        )
+        return [e for batch in batches for e in batch]
+
+    def _version_history_items(
+        self, task: ReplicationTask, events: List[HistoryEvent]
+    ) -> List[Dict[str, int]]:
+        """The version-history item list the passive side needs for LCA
+        computation. Derived from the run's stored mutable state when
+        available; falls back to the batch's own end item."""
+        end_id = events[-1].event_id
+        end_version = events[-1].version
+        try:
+            resp = self.shard.persistence.execution.get_workflow_execution(
+                self.shard.shard_id, task.domain_id, task.workflow_id,
+                task.run_id,
+            )
+            vh = (resp.snapshot or {}).get("version_histories")
+            # VersionHistory.to_dict stores items as [event_id, version]
+            # pairs (cadence_tpu/core/version_history.py to_dict)
+            for h in (vh or {}).get("histories", []):
+                items = [
+                    {"event_id": e, "version": v}
+                    for e, v in h.get("items", [])
+                ]
+                if items and items[-1]["event_id"] >= end_id:
+                    trimmed = [
+                        dict(j) for j in items if j["event_id"] < end_id
+                    ]
+                    trimmed.append(
+                        {"event_id": end_id, "version": end_version}
+                    )
+                    return trimmed
+        except EntityNotExistsError:
+            pass
+        return [{"event_id": end_id, "version": end_version}]
+
+    def hydrate(self, task: ReplicationTask) -> Optional[HistoryTaskV2]:
+        events = self._read_batch(
+            task.branch_token, task.first_event_id, task.next_event_id
+        )
+        if not events:
+            return None
+        new_run_events: List[HistoryEvent] = []
+        new_run_id = ""
+        if task.new_run_branch_token:
+            # the continued run's first batch starts at event 1
+            new_run_events = self._read_batch(task.new_run_branch_token, 1, 2)
+            if new_run_events:
+                new_run_id = new_run_events[0].attributes.get("run_id", "")
+                if not new_run_id:
+                    nb = BranchToken.from_json(
+                        task.new_run_branch_token.decode()
+                    )
+                    new_run_id = nb.tree_id
+        return HistoryTaskV2(
+            task_id=task.task_id,
+            domain_id=task.domain_id,
+            workflow_id=task.workflow_id,
+            run_id=task.run_id,
+            version_history_items=self._version_history_items(task, events),
+            events=events,
+            new_run_events=new_run_events,
+            new_run_id=new_run_id,
+        )
+
+    # -- pull API ------------------------------------------------------
+
+    def get_replication_messages(
+        self, cluster: str, last_retrieved_id: int
+    ) -> ReplicationMessages:
+        """Serve tasks after ``last_retrieved_id``; completing everything
+        the remote has already confirmed (replicatorQueueProcessor.go
+        getTasks: ack then read)."""
+        self.ack(cluster, last_retrieved_id)
+        tasks = self.shard.persistence.execution.get_replication_tasks(
+            self.shard.shard_id, last_retrieved_id, self.batch_size + 1
+        )
+        has_more = len(tasks) > self.batch_size
+        tasks = tasks[: self.batch_size]
+        out: List[HistoryTaskV2] = []
+        last_id = last_retrieved_id
+        for t in tasks:
+            msg = self.hydrate(t)
+            if msg is not None:
+                out.append(msg)
+            last_id = max(last_id, t.task_id)
+        return ReplicationMessages(
+            tasks=out, last_retrieved_id=last_id, has_more=has_more
+        )
+
+    def ack(self, cluster: str, level: int) -> None:
+        """Complete tasks every remote cluster has retrieved."""
+        with self._lock:
+            prev = self._cluster_ack.get(cluster, 0)
+            if level <= prev and prev != 0:
+                return
+            self._cluster_ack[cluster] = level
+            min_ack = min(self._cluster_ack.values())
+        done = self.shard.persistence.execution.get_replication_tasks(
+            self.shard.shard_id, 0, self.batch_size
+        )
+        for t in done:
+            if t.task_id <= min_ack:
+                self.shard.persistence.execution.complete_replication_task(
+                    self.shard.shard_id, t.task_id
+                )

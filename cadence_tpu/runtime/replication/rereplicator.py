@@ -1,0 +1,73 @@
+"""History re-replication: fill event gaps from the remote cluster.
+
+Reference: common/xdc/historyRereplicator.go:113-420 — when the passive
+side raises a retry error (missing earlier events), read the missing
+range [start_event_id+1, end_event_id) from the remote cluster's raw
+history API and apply it batch-by-batch through the same replicator,
+then let the caller retry the original task.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from cadence_tpu.core.events import HistoryEvent
+
+from .messages import HistoryTaskV2, RetryTaskV2Error
+
+
+class HistoryRereplicator:
+    def __init__(self, remote_client, replicator) -> None:
+        """``remote_client`` must expose get_workflow_history_raw(...)
+        → (batches, version_history_items); ``replicator`` is the local
+        NDCHistoryReplicator."""
+        self.remote = remote_client
+        self.replicator = replicator
+
+    def rereplicate(self, err: RetryTaskV2Error) -> int:
+        """Fetch + apply the missing range; returns batches applied."""
+        start = err.start_event_id + 1 if err.start_event_id else 1
+        end = err.end_event_id or (1 << 60)
+        batches, items = self.remote.get_workflow_history_raw(
+            err.domain_id, err.workflow_id, err.run_id, start, end
+        )
+        applied = 0
+        for batch in batches:
+            if not batch:
+                continue
+            task = HistoryTaskV2(
+                task_id=0,
+                domain_id=err.domain_id,
+                workflow_id=err.workflow_id,
+                run_id=err.run_id,
+                version_history_items=_items_up_to(items, batch),
+                events=list(batch),
+            )
+            self.replicator.apply_events(task)
+            applied += 1
+        return applied
+
+
+def _items_up_to(
+    items: List[dict], batch: List[HistoryEvent]
+) -> List[dict]:
+    """Trim the remote's version-history items to this batch's end —
+    each re-replicated batch must present the history as it was at that
+    point, or LCA math would see "future" items."""
+    end_id = batch[-1].event_id
+    end_version = batch[-1].version
+    out: List[dict] = []
+    for it in items:
+        if it["event_id"] < end_id:
+            out.append(dict(it))
+        else:
+            break
+    out.append({"event_id": end_id, "version": end_version})
+    # drop any stale prefix item with the same version as the boundary
+    dedup: List[dict] = []
+    for it in out:
+        if dedup and dedup[-1]["version"] == it["version"]:
+            dedup[-1] = it
+        else:
+            dedup.append(it)
+    return dedup

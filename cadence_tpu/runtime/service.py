@@ -33,7 +33,9 @@ class HistoryService:
         monitor: Monitor,
         time_source: Optional[TimeSource] = None,
         queue_worker_count: int = 4,
+        cluster_metadata=None,
     ) -> None:
+        self.cluster_metadata = cluster_metadata
         self.persistence = persistence
         self.domains = domain_cache
         self.monitor = monitor
@@ -68,6 +70,7 @@ class HistoryService:
 
     def _build_shard(self, shard: ShardContext) -> _ShardHandle:
         engine = HistoryEngine(shard, self.domains)
+        engine.cluster_metadata = self.cluster_metadata
         transfer = TransferQueueProcessor(
             shard, engine, self.matching_client, self.history_client,
             worker_count=self._queue_workers,
@@ -96,3 +99,25 @@ class HistoryService:
             for p in handle.processors:
                 ok = p.drain(timeout_s) and ok
         return ok
+
+    # -- replication plane ---------------------------------------------
+    # Reference: handler.go GetReplicationMessages / ReplicateEventsV2.
+
+    def replicate_events_v2(self, task) -> None:
+        engine = self.controller.get_engine(task.workflow_id)
+        engine.replicate_events_v2(task)
+
+    def get_replication_messages(
+        self, shard_id: int, last_retrieved_id: int, cluster: str
+    ):
+        engine = self.controller.get_engine_for_shard(shard_id)
+        return engine.get_replication_messages(cluster, last_retrieved_id)
+
+    def get_workflow_history_raw(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        start_event_id: int, end_event_id: int,
+    ):
+        engine = self.controller.get_engine(workflow_id)
+        return engine.get_workflow_history_raw(
+            domain_id, workflow_id, run_id, start_event_id, end_event_id
+        )

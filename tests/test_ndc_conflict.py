@@ -1,0 +1,249 @@
+"""NDC branch-divergence tests: fork + conflict-resolve rebuild, and
+stale-branch backfill.
+
+Mirrors the reference's host/ndc/nDC_integration_test.go shape: histories
+pushed straight through ReplicateEventsV2 against one cluster; divergent
+versions simulate the two sides of a failover writing concurrently
+(nDCBranchMgr fork → nDCConflictResolver rebuild / backfill).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import pytest
+
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+from cadence_tpu.core import history_factory as F
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.matching import MatchingEngine
+from cadence_tpu.runtime.domains import DomainCache, register_domain
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.replication import HistoryTaskV2
+from cadence_tpu.runtime.service import HistoryService
+
+SECOND = 1_000_000_000
+T0 = 1_700_000_000 * SECOND
+DOMAIN = "ndc-domain"
+ACTIVE_V = 1    # cluster "active" owns versions ≡1 (mod 10)
+STANDBY_V = 12  # cluster "standby" owns versions ≡2 (mod 10)
+
+
+class Box:
+    def __init__(self):
+        self.persistence = create_memory_bundle()
+        self.domain_id = register_domain(
+            self.persistence.metadata, DOMAIN, is_global=True,
+            clusters=["active", "standby"], active_cluster="active",
+            failover_version=ACTIVE_V,
+        )
+        self.domains = DomainCache(self.persistence.metadata)
+        self.history = HistoryService(
+            1, self.persistence, self.domains,
+            single_host_monitor("ndc-host"),
+            cluster_metadata=ClusterMetadata(
+                failover_version_increment=10,
+                master_cluster_name="active",
+                current_cluster_name="standby",
+                cluster_info={
+                    "active": ClusterInformation(initial_failover_version=1),
+                    "standby": ClusterInformation(initial_failover_version=2),
+                },
+            ),
+        )
+        self.history_client = HistoryClient(self.history.controller)
+        self.matching = MatchingEngine(self.persistence.task, self.history_client)
+        self.history.wire(MatchingClient(self.matching), self.history_client)
+        self.history.start()
+        self.engine = self.history.controller.get_engine_for_shard(0)
+
+    def stop(self):
+        self.history.stop()
+        self.matching.shutdown()
+
+
+@pytest.fixture()
+def box():
+    b = Box()
+    yield b
+    b.stop()
+
+
+def _task(box, wf_id, run_id, items, events, task_id=1):
+    return HistoryTaskV2(
+        task_id=task_id,
+        domain_id=box.domain_id,
+        workflow_id=wf_id,
+        run_id=run_id,
+        version_history_items=items,
+        events=events,
+    )
+
+
+def _base_batches(v=ACTIVE_V):
+    return (
+        [
+            F.workflow_execution_started(
+                1, v, T0, task_list="tl", workflow_type="wt",
+                execution_start_to_close_timeout_seconds=300,
+                task_start_to_close_timeout_seconds=10,
+            ),
+            F.decision_task_scheduled(2, v, T0),
+        ],
+        [F.decision_task_started(3, v, T0 + SECOND, scheduled_event_id=2)],
+    )
+
+
+def _seed(box, wf_id, run_id):
+    b1, b2 = _base_batches()
+    box.engine.replicate_events_v2(
+        _task(box, wf_id, run_id,
+              [{"event_id": 2, "version": ACTIVE_V}], b1, task_id=1)
+    )
+    box.engine.replicate_events_v2(
+        _task(box, wf_id, run_id,
+              [{"event_id": 3, "version": ACTIVE_V}], b2, task_id=2)
+    )
+
+
+def _load_ms(box, wf_id, run_id):
+    ctx = box.engine.cache.get_or_create(box.domain_id, wf_id, run_id)
+    with ctx.lock:
+        ctx.clear()
+        return ctx.load()
+
+
+def test_divergent_higher_version_forks_and_rebuilds(box):
+    """Incoming (3', v12) conflicts with local (3, v1): fork at LCA
+    event 2, rebuild from the fork, incoming becomes current."""
+    wf, run = "wf-fork", str(uuid.uuid4())
+    _seed(box, wf, run)
+
+    divergent = [
+        F.decision_task_started(3, STANDBY_V, T0 + 2 * SECOND, scheduled_event_id=2)
+    ]
+    box.engine.replicate_events_v2(
+        _task(
+            box, wf, run,
+            [{"event_id": 2, "version": ACTIVE_V},
+             {"event_id": 3, "version": STANDBY_V}],
+            divergent, task_id=3,
+        )
+    )
+
+    ms = _load_ms(box, wf, run)
+    vhs = ms.version_histories
+    assert len(vhs.histories) == 2
+    current = vhs.get_current_version_history()
+    assert current.last_item().version == STANDBY_V
+    assert current.last_item().event_id == 3
+    assert ms.next_event_id == 4
+    # decision is started per the winning branch
+    assert ms.execution_info.decision_started_id == 3
+
+    events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run)
+    assert [e.event_id for e in events] == [1, 2, 3]
+    assert events[-1].version == STANDBY_V
+
+
+def test_divergent_lower_version_backfills_stale_branch(box):
+    """Local moved ahead at v12; an old v1 batch arrives late: it lands
+    on a forked non-current branch; current state untouched."""
+    wf, run = "wf-backfill", str(uuid.uuid4())
+    b1, _ = _base_batches()
+    box.engine.replicate_events_v2(
+        _task(box, wf, run, [{"event_id": 2, "version": ACTIVE_V}], b1, 1)
+    )
+    # local continues at standby version (post-failover)
+    box.engine.replicate_events_v2(
+        _task(
+            box, wf, run,
+            [{"event_id": 2, "version": ACTIVE_V},
+             {"event_id": 3, "version": STANDBY_V}],
+            [F.decision_task_started(3, STANDBY_V, T0 + 2 * SECOND,
+                                     scheduled_event_id=2)],
+            2,
+        )
+    )
+    before = _load_ms(box, wf, run)
+    assert before.execution_info.decision_started_id == 3
+
+    # stale v1 continuation arrives late
+    box.engine.replicate_events_v2(
+        _task(
+            box, wf, run,
+            [{"event_id": 3, "version": ACTIVE_V}],
+            [F.decision_task_started(3, ACTIVE_V, T0 + SECOND,
+                                     scheduled_event_id=2)],
+            3,
+        )
+    )
+    ms = _load_ms(box, wf, run)
+    vhs = ms.version_histories
+    assert len(vhs.histories) == 2
+    assert vhs.get_current_version_history().last_item().version == STANDBY_V
+    stale = [
+        h for i, h in enumerate(vhs.histories) if i != vhs.current_index
+    ][0]
+    assert stale.last_item() == type(stale.last_item())(3, ACTIVE_V)
+    # current history still reads the winning branch
+    events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run)
+    assert events[-1].version == STANDBY_V
+
+
+def test_signal_on_stale_branch_reapplied_when_active(box):
+    """A signal that lands on a losing branch must not be lost: with the
+    local cluster active for the domain, it is re-minted on the current
+    branch (nDCEventsReapplier)."""
+    # make the local cluster ("standby") the active one for the domain
+    rec = box.domains.get_by_name(DOMAIN)
+    rec.replication_config.active_cluster_name = "standby"
+    rec.failover_version = STANDBY_V
+    box.persistence.metadata.update_domain(rec)
+
+    wf, run = "wf-reapply", str(uuid.uuid4())
+    _seed(box, wf, run)
+    # local wins with v12 continuation
+    box.engine.replicate_events_v2(
+        _task(
+            box, wf, run,
+            [{"event_id": 2, "version": ACTIVE_V},
+             {"event_id": 4, "version": STANDBY_V}],
+            [
+                F.decision_task_started(3, STANDBY_V, T0 + 2 * SECOND,
+                                        scheduled_event_id=2),
+                F.workflow_execution_signaled(
+                    4, STANDBY_V, T0 + 2 * SECOND, signal_name="kept",
+                ),
+            ],
+            3,
+        )
+    )
+    # stale v1 batch carries a signal that only the old branch saw
+    box.engine.replicate_events_v2(
+        _task(
+            box, wf, run,
+            [{"event_id": 4, "version": ACTIVE_V}],
+            [F.workflow_execution_signaled(
+                4, ACTIVE_V, T0 + 3 * SECOND, signal_name="rescued",
+            )],
+            4,
+        )
+    )
+    events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run)
+    names = [
+        e.attributes.get("signal_name")
+        for e in events
+        if e.event_type == EventType.WorkflowExecutionSignaled
+    ]
+    ms = _load_ms(box, wf, run)
+    buffered = [
+        e.attributes.get("signal_name")
+        for e in ms.buffered_events
+        if e.event_type == EventType.WorkflowExecutionSignaled
+    ]
+    # the decision is in flight on the winning branch, so the re-minted
+    # signal is buffered until it completes — either way it is not lost
+    assert "rescued" in names + buffered
