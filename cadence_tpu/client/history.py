@@ -159,3 +159,16 @@ class HistoryClient:
             workflow_id, "describe_workflow_execution", domain_name,
             workflow_id, run_id,
         )
+
+    def query_workflow(self, domain_name, workflow_id, run_id="", **kwargs):
+        return self._call(
+            workflow_id, "query_workflow", domain_name, workflow_id, run_id,
+            **kwargs
+        )
+
+    def reset_workflow_execution(self, domain_name, workflow_id, run_id="",
+                                 **kwargs):
+        return self._call(
+            workflow_id, "reset_workflow_execution", domain_name,
+            workflow_id, run_id, **kwargs
+        )

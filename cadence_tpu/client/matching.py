@@ -60,3 +60,16 @@ class MatchingClient:
         return self._engine_for(name).cancel_outstanding_polls(
             domain_id, name, task_type
         )
+
+    def query_workflow(self, domain_id, task_list, workflow_id, run_id,
+                       query_type, query_args=b"", timeout_s=10.0):
+        return self._engine_for(task_list).query_workflow(
+            domain_id, task_list, workflow_id, run_id, query_type,
+            query_args, timeout_s,
+        )
+
+    def respond_query_task_completed(self, task_list, query_id,
+                                     result=b"", error=""):
+        return self._engine_for(task_list).respond_query_task_completed(
+            query_id, result, error
+        )

@@ -156,6 +156,9 @@ class ActiveTransaction:
         if (
             probe.event_type in _BUFFERABLE
             and self.ms.has_inflight_decision()
+            # a decision closed earlier in this batch clears the
+            # in-flight state at close-replay; nothing to buffer behind
+            and not self._decision_closed_in_batch
         ):
             self.ms.buffered_events.append(probe)
             return probe
@@ -207,8 +210,24 @@ class ActiveTransaction:
         if not self._decision_closed_in_batch and self.ms.has_pending_decision():
             raise WorkflowStateError("decision already scheduled")
         ei = self.ms.execution_info
-        task_list = ei.sticky_task_list or task_list or ei.task_list
-        timeout = timeout_seconds or ei.decision_timeout_value
+        # during the start transaction ms is still empty (replay is
+        # deferred to close) — read defaults off the in-batch started
+        # event (reference: scheduling reads mutableState populated
+        # eagerly; our deferred replay needs the batch fallback)
+        started_attrs: Dict[str, Any] = {}
+        for ev in self.batch:
+            if ev.event_type == EventType.WorkflowExecutionStarted:
+                started_attrs = ev.attributes
+                break
+        task_list = (
+            ei.sticky_task_list or task_list or ei.task_list
+            or started_attrs.get("task_list", "")
+        )
+        timeout = (
+            timeout_seconds
+            or ei.decision_timeout_value
+            or started_attrs.get("task_start_to_close_timeout_seconds", 0)
+        )
         if ei.decision_attempt > 0 and not self._decision_closed_in_batch:
             # transient: no event until completion materializes it
             decision = self.ms.replicate_transient_decision_task_scheduled(now)

@@ -74,6 +74,9 @@ class MatchingEngine:
             "matching.numTasklistReadPartitions", 1
         )
         self._tasklist_rps = cfg.float_property("matching.rps", 100000.0)
+        # in-flight sync queries: query_id → (event, result slot)
+        self._query_lock = threading.Lock()
+        self._pending_queries: Dict[str, tuple] = {}
 
     # -- manager registry ----------------------------------------------
 
@@ -172,6 +175,10 @@ class MatchingEngine:
             if task is None:
                 continue  # interrupted or forwarded miss; re-check deadline
             info = task.info
+            if task.query is not None:
+                # sync query task: no started event, no history write
+                task.finish(None)
+                return task, {"query": task.query}
             request_id = str(uuid.uuid4())
             try:
                 if task_type == TASK_TYPE_DECISION:
@@ -199,6 +206,19 @@ class MatchingEngine:
         task, resp = self._poll_loop(req, TASK_TYPE_DECISION)
         if task is None:
             return None
+        if "query" in resp:
+            q = resp["query"]
+            return PollForDecisionTaskResponse(
+                task_token={"query_id": q["query_id"]},
+                workflow_id=task.info.workflow_id,
+                run_id=task.info.run_id,
+                workflow_type="",
+                previous_started_event_id=0,
+                started_event_id=0,
+                attempt=0,
+                history=[],
+                query=q,
+            )
         return PollForDecisionTaskResponse(
             task_token=resp["task_token"],
             workflow_id=task.info.workflow_id,
@@ -208,6 +228,7 @@ class MatchingEngine:
             started_event_id=resp["started_event_id"],
             attempt=resp["attempt"],
             history=resp["history"],
+            queries=resp.get("queries") or {},
         )
 
     def poll_for_activity_task(
@@ -235,6 +256,68 @@ class MatchingEngine:
             attempt=resp["attempt"],
             heartbeat_details=resp["heartbeat_details"],
         )
+
+    # -- sync query (matcher OfferQuery / RespondQueryTaskCompleted) ----
+
+    def query_workflow(
+        self,
+        domain_id: str,
+        task_list: str,
+        workflow_id: str,
+        run_id: str,
+        query_type: str,
+        query_args: bytes = b"",
+        timeout_s: float = 10.0,
+    ) -> bytes:
+        """Dispatch a query task to a live poller and wait for its
+        answer (reference matchingEngine.QueryWorkflow — queries are
+        never persisted; no poller in time → query fails)."""
+        from cadence_tpu.runtime.api import QueryFailedError
+
+        query_id = str(uuid.uuid4())
+        info = TaskInfo(
+            domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
+            task_id=-1, schedule_id=-1,
+        )
+        task = InternalTask(info, finish=None, sync=True)
+        task.query = {
+            "query_id": query_id,
+            "query_type": query_type,
+            "query_args": query_args,
+        }
+        done = threading.Event()
+        slot: dict = {}
+        with self._query_lock:
+            self._pending_queries[query_id] = (done, slot)
+        try:
+            part = self._pick_partition(domain_id, task_list, write=True)
+            mgr = self._get_manager(
+                TaskListID(domain_id, part, TASK_TYPE_DECISION)
+            )
+            if not mgr.matcher.offer(task, timeout=timeout_s / 2):
+                raise QueryFailedError(
+                    f"no poller on task list {task_list} to answer query"
+                )
+            if not done.wait(timeout_s):
+                raise QueryFailedError("query timed out")
+            if slot.get("error"):
+                raise QueryFailedError(slot["error"])
+            return slot.get("result") or b""
+        finally:
+            with self._query_lock:
+                self._pending_queries.pop(query_id, None)
+
+    def respond_query_task_completed(
+        self, query_id: str, result: bytes = b"", error: str = ""
+    ) -> None:
+        with self._query_lock:
+            entry = self._pending_queries.get(query_id)
+        if entry is None:
+            return  # query already timed out / completed
+        done, slot = entry
+        slot["result"] = result
+        slot["error"] = error
+        done.set()
 
     # -- admin ----------------------------------------------------------
 

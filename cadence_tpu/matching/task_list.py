@@ -78,7 +78,7 @@ class TaskListID:
 class InternalTask:
     """A dispatched task: persisted backlog entry or ephemeral sync match."""
 
-    __slots__ = ("info", "_finish", "finished", "sync", "started_response")
+    __slots__ = ("info", "_finish", "finished", "sync", "started_response", "query")
 
     def __init__(
         self, info: TaskInfo, finish: Optional[Callable[[Optional[Exception]], None]],
@@ -89,6 +89,7 @@ class InternalTask:
         self.finished = False
         self.sync = sync
         self.started_response = None
+        self.query = None  # sync query task payload (matcher.OfferQuery)
 
     def finish(self, error: Optional[Exception] = None) -> None:
         if self.finished:

@@ -167,6 +167,10 @@ class PollForDecisionTaskResponse:
     backlog_count_hint: int = 0
     scheduled_timestamp: int = 0
     started_timestamp: int = 0
+    # direct (sync) query task: {"query_id", "query_type", "query_args"}
+    query: Optional[Dict[str, Any]] = None
+    # consistent queries piggybacked on a real decision task
+    queries: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

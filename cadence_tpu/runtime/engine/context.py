@@ -32,6 +32,7 @@ class WorkflowExecutionContext:
         domain_id: str,
         workflow_id: str,
         run_id: str,
+        on_persist=None,
     ) -> None:
         self.shard = shard
         self.domain_id = domain_id
@@ -40,6 +41,8 @@ class WorkflowExecutionContext:
         self.lock = threading.RLock()
         self._ms: Optional[MutableState] = None
         self._condition = 0
+        # invoked after every durable write (historyEventNotifier feed)
+        self._on_persist = on_persist or (lambda ms: None)
 
     # -- load ---------------------------------------------------------
 
@@ -172,6 +175,7 @@ class WorkflowExecutionContext:
         )
         self._ms = ms
         self._condition = ms.next_event_id
+        self._on_persist(ms)
 
     def update_workflow(
         self, ms: MutableState, result: TransactionResult
@@ -226,6 +230,7 @@ class WorkflowExecutionContext:
             new_snapshot=new_snapshot,
         )
         self._condition = ms.next_event_id
+        self._on_persist(ms)
 
     # -- reads --------------------------------------------------------
 

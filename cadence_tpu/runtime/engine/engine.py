@@ -55,6 +55,8 @@ from ..shard import ShardContext
 from .cache import HistoryCache
 from .context import WorkflowExecutionContext
 from .decision_handler import DecisionFailure, DecisionTaskHandler
+from .notifier import HistoryEventNotifier
+from .query import QueryRegistry
 
 _CONDITION_RETRY_COUNT = 5  # reference: workflowExecutionContext conditionalRetryCount
 
@@ -72,9 +74,14 @@ class HistoryEngine:
         self.domains = domain_cache
         self.metrics = metrics.tagged(service="history", shard=str(shard.shard_id))
         self.log = get_logger("cadence_tpu.history", shard=shard.shard_id)
+        self.event_notifier = HistoryEventNotifier()
         self.cache = HistoryCache(
-            lambda d, w, r: WorkflowExecutionContext(shard, d, w, r)
+            lambda d, w, r: WorkflowExecutionContext(
+                shard, d, w, r, on_persist=self._publish_progress
+            )
         )
+        self.query_registry = QueryRegistry()
+        self.matching_client = None  # wired by the service for queries
         # queue processors poke these after each persisted transaction
         self._task_notifier = task_notifier or (lambda: None)
         self._timer_notifier = timer_notifier or (lambda: None)
@@ -86,6 +93,13 @@ class HistoryEngine:
             domain_record.failover_version
             if domain_record.is_global
             else EMPTY_VERSION
+        )
+
+    def _publish_progress(self, ms: MutableState) -> None:
+        ei = ms.execution_info
+        self.event_notifier.notify(
+            ei.domain_id, ei.workflow_id, ei.run_id,
+            ms.next_event_id, ms.is_workflow_execution_running(),
         )
 
     def _notify(self, result: TransactionResult) -> None:
@@ -420,7 +434,17 @@ class HistoryEngine:
                 ),
             }
 
-        return self._update_workflow(domain_id, workflow_id, run_id, action)
+        resp = self._update_workflow(domain_id, workflow_id, run_id, action)
+        # consistent queries ride the decision task (queryRegistry
+        # buffered → started). Attached only AFTER the dispatch
+        # persisted — a condition-retried action must not consume them.
+        resp["queries"] = {
+            q.id: {"query_type": q.query_type, "query_args": q.query_args}
+            for q in self.query_registry.take_buffered(
+                domain_id, workflow_id, run_id
+            )
+        }
+        return resp
 
     def respond_decision_task_completed(
         self,
@@ -430,11 +454,16 @@ class HistoryEngine:
         binary_checksum: str = "",
         sticky_task_list: str = "",
         sticky_schedule_to_start_timeout_seconds: int = 0,
+        query_results: Optional[Dict[str, Dict[str, Any]]] = None,
     ) -> None:
         domain_id = task_token["domain_id"]
         workflow_id = task_token["workflow_id"]
         run_id = task_token["run_id"]
         schedule_id = task_token["schedule_id"]
+        if query_results:
+            self.query_registry.complete(
+                domain_id, workflow_id, run_id, query_results
+            )
 
         def action(ctx, ms):
             ei = ms.execution_info
@@ -477,11 +506,16 @@ class HistoryEngine:
                     ctx, schedule_id, failure.cause, str(failure), identity
                 )
                 return
-            # events needing a fresh decision: flushed buffered events or
-            # a dropped close
+            # events needing a fresh decision: flushed buffered events, a
+            # dropped close, or queries buffered after this decision
+            # dispatched (reference handleDecisionTaskCompleted schedules
+            # a new decision to carry outstanding buffered queries)
             if not handler.workflow_closed and (
                 handler.unhandled_close_dropped
                 or self._needs_new_decision(txn, completed.event_id)
+                or self.query_registry.pending_count(
+                    domain_id, workflow_id, run_id
+                ) > 0
             ):
                 txn.add_decision_task_scheduled(now)
             result = txn.close()
@@ -834,8 +868,34 @@ class HistoryEngine:
     def get_workflow_execution_history(
         self, domain_name: str, workflow_id: str, run_id: str = "",
         first_event_id: int = 1, page_size: int = 0, next_token: int = 0,
+        wait_for_new_event: bool = False, long_poll_timeout_s: float = 10.0,
     ) -> Tuple[List[HistoryEvent], int]:
         domain_id = self.domains.get_by_name(domain_name).info.id
+        if not run_id:
+            run_id = self._current_run_id(domain_id, workflow_id)
+
+        def probe(ctx, ms):
+            return ms.next_event_id, ms.is_workflow_execution_running()
+
+        if wait_for_new_event:
+            # long-poll: block until events past first_event_id exist.
+            # Subscribe BEFORE probing — an event persisted between probe
+            # and subscribe must not be missed (reference notifier
+            # ordering: watch, then read).
+            sub = self.event_notifier.subscribe(
+                domain_id, workflow_id, run_id
+            )
+            try:
+                next_id, running = self._update_workflow(
+                    domain_id, workflow_id, run_id, probe
+                )
+                sub.publish(next_id, running)  # seed with current state
+                if next_id <= first_event_id and running:
+                    sub.wait_for(first_event_id, long_poll_timeout_s)
+            finally:
+                self.event_notifier.unsubscribe(
+                    domain_id, workflow_id, run_id, sub
+                )
 
         def action(ctx, ms):
             return ctx.read_history(
@@ -925,7 +985,13 @@ class HistoryEngine:
         if getattr(self, "_replicator_queue", None) is None:
             from ..replication.replicator_queue import ReplicatorQueueProcessor
 
-            self._replicator_queue = ReplicatorQueueProcessor(self.shard)
+            cm = getattr(self, "cluster_metadata", None)
+            self._replicator_queue = ReplicatorQueueProcessor(
+                self.shard,
+                remote_clusters=(
+                    cm.enabled_remote_clusters() if cm is not None else None
+                ),
+            )
         return self._replicator_queue
 
     def replicate_events_v2(self, task) -> None:
@@ -970,3 +1036,81 @@ class HistoryEngine:
             branch, start_event_id, end_event_id
         )
         return batches, items
+
+    # -- consistent query (queryRegistry + queryStateMachine) ----------
+
+    def query_workflow(
+        self,
+        domain_name: str,
+        workflow_id: str,
+        run_id: str = "",
+        query_type: str = "",
+        query_args: bytes = b"",
+        timeout_s: float = 10.0,
+        reject_not_open: bool = False,
+    ) -> bytes:
+        """Reference historyEngine QueryWorkflow: buffer on a pending
+        decision (piggyback on its dispatch) or sync-dispatch a query
+        task straight to matching when the workflow is idle."""
+        from ..api import QueryFailedError
+
+        domain_id = self.domains.get_by_name(domain_name).info.id
+        if not run_id:
+            run_id = self._current_run_id(domain_id, workflow_id)
+
+        def probe(ctx, ms):
+            return (
+                ms.is_workflow_execution_running(),
+                ms.has_pending_decision(),
+                ms.execution_info.task_list,
+            )
+
+        running, pending_decision, task_list = self._update_workflow(
+            domain_id, workflow_id, run_id, probe
+        )
+        if reject_not_open and not running:
+            raise QueryFailedError("workflow is not open")
+
+        if pending_decision and running:
+            q = self.query_registry.buffer(
+                domain_id, workflow_id, run_id, query_type, query_args
+            )
+            if not q.wait(timeout_s):
+                self.query_registry.fail(
+                    domain_id, workflow_id, run_id, q, "query timed out"
+                )
+                raise QueryFailedError("query timed out")
+            if q.error:
+                raise QueryFailedError(q.error)
+            return q.result or b""
+
+        if self.matching_client is None:
+            raise InternalServiceError("matching client not wired for query")
+        return self.matching_client.query_workflow(
+            domain_id, task_list, workflow_id, run_id,
+            query_type, query_args, timeout_s,
+        )
+
+    # -- workflow reset (workflowResetor.go) ---------------------------
+
+    def reset_workflow_execution(
+        self,
+        domain_name: str,
+        workflow_id: str,
+        run_id: str = "",
+        reason: str = "",
+        decision_finish_event_id: int = 0,
+        request_id: str = "",
+        identity: str = "",
+    ) -> str:
+        """Fork at a decision boundary and restart from there; returns
+        the new run id."""
+        from .resetor import WorkflowResetor
+
+        domain_id = self.domains.get_by_name(domain_name).info.id
+        if not run_id:
+            run_id = self._current_run_id(domain_id, workflow_id)
+        return WorkflowResetor(self).reset_workflow_execution(
+            domain_id, workflow_id, run_id, reason,
+            decision_finish_event_id, request_id, identity,
+        )

@@ -38,3 +38,17 @@ class DeferTask(Exception):
     task back to the queue after a standby delay — mirroring the
     reference's standby task processors, which hold tasks until the
     domain fails over or replication catches up."""
+
+
+STANDBY_RETRY_DELAY_S = 0.5
+
+
+def defer_task(ack, key, delay_s: float = STANDBY_RETRY_DELAY_S) -> None:
+    """Release a deferred (passive-domain) task back to its queue after
+    a standby delay: the ack entry is abandoned on a timer so the pump
+    re-reads it without hot-looping."""
+    import threading
+
+    t = threading.Timer(delay_s, ack.abandon, [key])
+    t.daemon = True
+    t.start()

@@ -17,7 +17,7 @@ from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
-from .allocator import DeferTask
+from .allocator import DeferTask, defer_task
 
 _TASK_RETRY_COUNT = 3
 
@@ -102,16 +102,6 @@ class QueueProcessorBase:
             if len(batch) < self._batch_size:
                 return
 
-    _STANDBY_RETRY_DELAY_S = 0.5
-
-    def _defer(self, key) -> None:
-        """Release a passive-domain task back to the queue after a
-        standby delay (the reference's standby processors hold tasks
-        until failover or replication catches up)."""
-        t = threading.Timer(self._STANDBY_RETRY_DELAY_S, self.ack.abandon, [key])
-        t.daemon = True
-        t.start()
-
     def _run_task(self, task, key) -> None:
         for attempt in range(_TASK_RETRY_COUNT):
             if self._stopped.is_set():
@@ -120,7 +110,7 @@ class QueueProcessorBase:
                 self._process_task(task)
                 break
             except DeferTask:
-                self._defer(key)
+                defer_task(self.ack, key)
                 return
             except EntityNotExistsServiceError:
                 break  # stale task: workflow/decision moved on

@@ -23,7 +23,7 @@ from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
-from .allocator import DeferTask, TaskAllocator
+from .allocator import DeferTask, TaskAllocator, defer_task
 from .timer_gate import LocalTimerGate
 
 _TIMEOUT_REASON = "cadenceInternal:Timeout"
@@ -120,14 +120,6 @@ class TimerQueueProcessor:
 
     _TASK_RETRY_COUNT = 3
 
-    _STANDBY_RETRY_DELAY_S = 0.5
-
-    def _defer(self, key) -> None:
-        """Release the task back to the queue after a standby delay."""
-        t = threading.Timer(self._STANDBY_RETRY_DELAY_S, self.ack.abandon, [key])
-        t.daemon = True
-        t.start()
-
     def _run_task(self, task: TimerTask, key) -> None:
         for attempt in range(self._TASK_RETRY_COUNT):
             if self._stopped.is_set():
@@ -136,7 +128,7 @@ class TimerQueueProcessor:
                 self._process(task)
                 break
             except DeferTask:
-                self._defer(key)
+                defer_task(self.ack, key)
                 return
             except EntityNotExistsServiceError:
                 break  # workflow gone / state moved on: stale timer

@@ -58,12 +58,18 @@ class ReplicationTaskFetcher:
             return self._cursor.get(shard_id, 0)
 
     def fetch(self, shard_id: int) -> ReplicationMessages:
-        msgs = self.client.get_replication_messages(
+        """Read past the committed cursor WITHOUT advancing it — the
+        processor commits only after tasks apply, so a failed apply is
+        re-fetched (at-least-once, matching the reference's
+        lastProcessedMessageId ack)."""
+        return self.client.get_replication_messages(
             shard_id, self.last_retrieved(shard_id)
         )
+
+    def commit(self, shard_id: int, applied_through: int) -> None:
         with self._lock:
-            self._cursor[shard_id] = msgs.last_retrieved_id
-        return msgs
+            if applied_through > self._cursor.get(shard_id, 0):
+                self._cursor[shard_id] = applied_through
 
 
 class ReplicationTaskProcessor:
@@ -88,12 +94,18 @@ class ReplicationTaskProcessor:
     # -- synchronous drain (tests + backlog catch-up) ------------------
 
     def process_once(self) -> int:
-        """One fetch + apply cycle; returns number of tasks applied."""
+        """One fetch + apply cycle; returns number of tasks applied. The
+        cursor commits per successfully applied task, so a failure mid-
+        batch re-fetches from the failed task."""
         msgs = self.fetcher.fetch(self.shard.shard_id)
         applied = 0
         for task in msgs.tasks:
             self._process_task(task)
+            self.fetcher.commit(self.shard.shard_id, task.task_id)
             applied += 1
+        if not msgs.tasks:
+            # nothing to apply in the range: safe to move past it
+            self.fetcher.commit(self.shard.shard_id, msgs.last_retrieved_id)
         return applied
 
     def drain(self, max_rounds: int = 100) -> int:

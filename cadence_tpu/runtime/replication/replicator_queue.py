@@ -25,12 +25,21 @@ from .messages import HistoryTaskV2, ReplicationMessages
 class ReplicatorQueueProcessor:
     """Per-shard emit side of replication."""
 
-    def __init__(self, shard: ShardContext, batch_size: int = 100) -> None:
+    def __init__(
+        self,
+        shard: ShardContext,
+        batch_size: int = 100,
+        remote_clusters: Optional[List[str]] = None,
+    ) -> None:
         self.shard = shard
         self.batch_size = batch_size
         self._lock = threading.Lock()
-        # last task id each remote cluster has confirmed processing
-        self._cluster_ack: Dict[str, int] = {}
+        # last task id each remote cluster has confirmed processing —
+        # pre-seeded with every configured remote so one cluster's ack
+        # can't delete tasks another has yet to fetch
+        self._cluster_ack: Dict[str, int] = {
+            c: 0 for c in (remote_clusters or [])
+        }
 
     # -- hydration ----------------------------------------------------
 
@@ -87,8 +96,15 @@ class ReplicatorQueueProcessor:
         new_run_events: List[HistoryEvent] = []
         new_run_id = ""
         if task.new_run_branch_token:
-            # the continued run's first batch starts at event 1
-            new_run_events = self._read_batch(task.new_run_branch_token, 1, 2)
+            # the continued run's FULL first transaction batch (Started +
+            # DecisionTaskScheduled — active_transaction new-run close)
+            branch = BranchToken.from_json(
+                task.new_run_branch_token.decode()
+            )
+            batches, _ = self.shard.persistence.history.read_history_branch(
+                branch, 1, 1 << 60
+            )
+            new_run_events = list(batches[0]) if batches else []
             if new_run_events:
                 new_run_id = new_run_events[0].attributes.get("run_id", "")
                 if not new_run_id:
@@ -140,11 +156,21 @@ class ReplicatorQueueProcessor:
                 return
             self._cluster_ack[cluster] = level
             min_ack = min(self._cluster_ack.values())
-        done = self.shard.persistence.execution.get_replication_tasks(
-            self.shard.shard_id, 0, self.batch_size
-        )
-        for t in done:
-            if t.task_id <= min_ack:
-                self.shard.persistence.execution.complete_replication_task(
-                    self.shard.shard_id, t.task_id
-                )
+        if min_ack <= 0:
+            return
+        # scan the whole completed prefix, not just one batch
+        read_from = 0
+        while True:
+            done = self.shard.persistence.execution.get_replication_tasks(
+                self.shard.shard_id, read_from, self.batch_size
+            )
+            if not done:
+                return
+            for t in done:
+                if t.task_id <= min_ack:
+                    self.shard.persistence.execution.complete_replication_task(
+                        self.shard.shard_id, t.task_id
+                    )
+            read_from = done[-1].task_id
+            if read_from > min_ack:
+                return

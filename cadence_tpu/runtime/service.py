@@ -71,6 +71,7 @@ class HistoryService:
     def _build_shard(self, shard: ShardContext) -> _ShardHandle:
         engine = HistoryEngine(shard, self.domains)
         engine.cluster_metadata = self.cluster_metadata
+        engine.matching_client = self.matching_client
         transfer = TransferQueueProcessor(
             shard, engine, self.matching_client, self.history_client,
             worker_count=self._queue_workers,

@@ -197,14 +197,36 @@ def test_signal_on_stale_branch_reapplied_when_active(box):
     """A signal that lands on a losing branch must not be lost: with the
     local cluster active for the domain, it is re-minted on the current
     branch (nDCEventsReapplier)."""
+    import time as _time
+
     # make the local cluster ("standby") the active one for the domain
     rec = box.domains.get_by_name(DOMAIN)
     rec.replication_config.active_cluster_name = "standby"
     rec.failover_version = STANDBY_V
     box.persistence.metadata.update_domain(rec)
 
+    # events stamped near NOW: the domain is active here, so the live
+    # timer queue runs against real time — a past T0 would let decision/
+    # workflow timeouts close the run before the stale batch arrives
+    t0 = int(_time.time()) * SECOND
+
     wf, run = "wf-reapply", str(uuid.uuid4())
-    _seed(box, wf, run)
+    b1 = [
+        F.workflow_execution_started(
+            1, ACTIVE_V, t0, task_list="tl", workflow_type="wt",
+            execution_start_to_close_timeout_seconds=300,
+            task_start_to_close_timeout_seconds=60,
+        ),
+        F.decision_task_scheduled(2, ACTIVE_V, t0),
+    ]
+    box.engine.replicate_events_v2(
+        _task(box, wf, run, [{"event_id": 2, "version": ACTIVE_V}], b1, 1)
+    )
+    box.engine.replicate_events_v2(
+        _task(box, wf, run, [{"event_id": 3, "version": ACTIVE_V}],
+              [F.decision_task_started(3, ACTIVE_V, t0 + SECOND,
+                                       scheduled_event_id=2)], 2)
+    )
     # local wins with v12 continuation
     box.engine.replicate_events_v2(
         _task(
@@ -212,10 +234,10 @@ def test_signal_on_stale_branch_reapplied_when_active(box):
             [{"event_id": 2, "version": ACTIVE_V},
              {"event_id": 4, "version": STANDBY_V}],
             [
-                F.decision_task_started(3, STANDBY_V, T0 + 2 * SECOND,
+                F.decision_task_started(3, STANDBY_V, t0 + 2 * SECOND,
                                         scheduled_event_id=2),
                 F.workflow_execution_signaled(
-                    4, STANDBY_V, T0 + 2 * SECOND, signal_name="kept",
+                    4, STANDBY_V, t0 + 2 * SECOND, signal_name="kept",
                 ),
             ],
             3,
@@ -227,23 +249,33 @@ def test_signal_on_stale_branch_reapplied_when_active(box):
             box, wf, run,
             [{"event_id": 4, "version": ACTIVE_V}],
             [F.workflow_execution_signaled(
-                4, ACTIVE_V, T0 + 3 * SECOND, signal_name="rescued",
+                4, ACTIVE_V, t0 + 3 * SECOND, signal_name="rescued",
             )],
             4,
         )
     )
-    events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run)
-    names = [
-        e.attributes.get("signal_name")
-        for e in events
-        if e.event_type == EventType.WorkflowExecutionSignaled
-    ]
-    ms = _load_ms(box, wf, run)
-    buffered = [
-        e.attributes.get("signal_name")
-        for e in ms.buffered_events
-        if e.event_type == EventType.WorkflowExecutionSignaled
-    ]
-    # the decision is in flight on the winning branch, so the re-minted
-    # signal is buffered until it completes — either way it is not lost
-    assert "rescued" in names + buffered
+    # the re-minted signal is either buffered (decision in flight) or —
+    # once the decision closes — flushed into history; poll both places
+    # to ride out the background timer queue
+    import time as _time
+
+    deadline = _time.monotonic() + 3.0
+    while True:
+        events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run)
+        names = [
+            e.attributes.get("signal_name")
+            for e in events
+            if e.event_type == EventType.WorkflowExecutionSignaled
+        ]
+        ms = _load_ms(box, wf, run)
+        buffered = [
+            e.attributes.get("signal_name")
+            for e in ms.buffered_events
+            if e.event_type == EventType.WorkflowExecutionSignaled
+        ]
+        if "rescued" in names + buffered:
+            break
+        assert _time.monotonic() < deadline, (
+            f"signal lost: history={names} buffered={buffered}"
+        )
+        _time.sleep(0.05)

@@ -1,0 +1,226 @@
+"""Consistent query, workflow reset, and long-poll history tests.
+
+Reference strategies: host/queryworkflow_test.go (direct + piggybacked
+query), workflowResetor tests, gethistory_test.go (long poll).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from cadence_tpu.core.enums import DecisionType, EventType
+from cadence_tpu.matching import PollRequest
+from cadence_tpu.runtime.api import Decision, QueryFailedError, SignalRequest
+from tests.test_service_plane import Box, _start
+
+
+@pytest.fixture()
+def box():
+    b = Box()
+    yield b
+    b.stop()
+
+
+def _complete_first_decision(box, task_list):
+    box.poll_and_respond(task_list, [])
+
+
+class TestQuery:
+    def test_direct_query_idle_workflow(self, box):
+        _start(box, "wf-q1", "tl-q")
+        _complete_first_decision(box, "tl-q")  # workflow now idle
+
+        results = {}
+
+        def worker():
+            # poller waits for the sync query task
+            task = box.poll_decision("tl-q", timeout_s=5.0)
+            assert task is not None and task.query is not None
+            box.matching.respond_query_task_completed(
+                task.query["query_id"], result=b"state-42"
+            )
+            results["served"] = True
+
+        th = threading.Thread(target=worker)
+        th.start()
+        engine = box.history.controller.get_engine("wf-q1")
+        out = engine.query_workflow(
+            "it-domain", "wf-q1", query_type="get_state", timeout_s=5.0
+        )
+        th.join(5.0)
+        assert out == b"state-42"
+        assert results.get("served")
+
+    def test_buffered_query_rides_decision_task(self, box):
+        _start(box, "wf-q2", "tl-q2")
+        # decision task is pending (not yet polled) → query buffers
+        engine = box.history.controller.get_engine("wf-q2")
+        out = {}
+
+        def querier():
+            try:
+                out["result"] = engine.query_workflow(
+                    "it-domain", "wf-q2", query_type="q", timeout_s=5.0
+                )
+            except Exception as e:  # pragma: no cover
+                out["error"] = e
+
+        th = threading.Thread(target=querier)
+        th.start()
+        time.sleep(0.1)  # let it buffer
+
+        task = box.poll_decision("tl-q2")
+        assert task is not None
+        assert task.queries, "buffered query not attached to decision task"
+        qid = next(iter(task.queries))
+        box.history_client.respond_decision_task_completed(
+            task.task_token, [],
+            query_results={qid: {"result": b"answered"}},
+        )
+        th.join(5.0)
+        assert out.get("result") == b"answered"
+
+    def test_query_no_poller_fails(self, box):
+        _start(box, "wf-q3", "tl-q3")
+        _complete_first_decision(box, "tl-q3")
+        engine = box.history.controller.get_engine("wf-q3")
+        with pytest.raises(QueryFailedError):
+            engine.query_workflow(
+                "it-domain", "wf-q3", query_type="q", timeout_s=0.4
+            )
+
+
+class TestReset:
+    def test_reset_forks_and_restarts(self, box):
+        run_id = _start(box, "wf-r1", "tl-r")
+        # complete decision #1 scheduling an activity
+        box.poll_and_respond(
+            "tl-r",
+            [Decision(DecisionType.ScheduleActivityTask, {
+                "activity_id": "a1", "activity_type": "act",
+                "task_list": "tl-r",
+                "schedule_to_close_timeout_seconds": 60,
+                "schedule_to_start_timeout_seconds": 60,
+                "start_to_close_timeout_seconds": 60,
+                "heartbeat_timeout_seconds": 0,
+            })],
+        )
+        engine = box.history.controller.get_engine("wf-r1")
+        events, _ = engine.get_workflow_execution_history(
+            "it-domain", "wf-r1", run_id
+        )
+        # find DecisionTaskCompleted event id
+        completed = [
+            e for e in events
+            if e.event_type == EventType.DecisionTaskCompleted
+        ][0]
+
+        new_run = engine.reset_workflow_execution(
+            "it-domain", "wf-r1", run_id,
+            reason="test-reset",
+            decision_finish_event_id=completed.event_id,
+        )
+        assert new_run and new_run != run_id
+
+        # old run terminated
+        old_events, _ = engine.get_workflow_execution_history(
+            "it-domain", "wf-r1", run_id
+        )
+        assert old_events[-1].event_type == EventType.WorkflowExecutionTerminated
+
+        # new run: prefix + DecisionTaskFailed(reset) + new decision
+        new_events, _ = engine.get_workflow_execution_history(
+            "it-domain", "wf-r1", new_run
+        )
+        types = [e.event_type for e in new_events]
+        assert types[0] == EventType.WorkflowExecutionStarted
+        assert EventType.DecisionTaskFailed in types
+        # the fresh decision is transient (attempt > 0): no scheduled
+        # event in history until it completes — but it must dispatch
+        # the activity scheduled after the reset point is gone
+        assert EventType.ActivityTaskScheduled not in types
+
+        # new run is pollable: a fresh decision task dispatches
+        task = box.poll_decision("tl-r", timeout_s=5.0)
+        assert task is not None and task.run_id == new_run
+
+    def test_reset_rejects_bad_point(self, box):
+        run_id = _start(box, "wf-r2", "tl-r2")
+        engine = box.history.controller.get_engine("wf-r2")
+        from cadence_tpu.runtime.api import BadRequestError
+
+        with pytest.raises(BadRequestError):
+            engine.reset_workflow_execution(
+                "it-domain", "wf-r2", run_id,
+                reason="bad", decision_finish_event_id=1,
+            )
+
+    def test_reset_carries_signals_after_cut(self, box):
+        run_id = _start(box, "wf-r3", "tl-r3")
+        box.poll_and_respond("tl-r3", [])
+        box.history_client.signal_workflow_execution(
+            SignalRequest(
+                domain="it-domain", workflow_id="wf-r3",
+                signal_name="keep-me", input=b"\x07", identity="t",
+            )
+        )
+        engine = box.history.controller.get_engine("wf-r3")
+        events, _ = engine.get_workflow_execution_history(
+            "it-domain", "wf-r3", run_id
+        )
+        completed = [
+            e for e in events
+            if e.event_type == EventType.DecisionTaskCompleted
+        ][0]
+        new_run = engine.reset_workflow_execution(
+            "it-domain", "wf-r3", run_id,
+            reason="keep-signals",
+            decision_finish_event_id=completed.event_id,
+        )
+        new_events, _ = engine.get_workflow_execution_history(
+            "it-domain", "wf-r3", new_run
+        )
+        sigs = [
+            e.attributes.get("signal_name")
+            for e in new_events
+            if e.event_type == EventType.WorkflowExecutionSignaled
+        ]
+        assert "keep-me" in sigs
+
+
+class TestLongPoll:
+    def test_long_poll_wakes_on_new_event(self, box):
+        run_id = _start(box, "wf-lp", "tl-lp")
+        task = box.poll_decision("tl-lp")
+        engine = box.history.controller.get_engine("wf-lp")
+        events, _ = engine.get_workflow_execution_history(
+            "it-domain", "wf-lp", run_id
+        )
+        known = events[-1].event_id
+        got = {}
+
+        # wait for events BEYOND the ones already seen: the watermark is
+        # the next unseen event id
+        def waiter2():
+            ev, _ = engine.get_workflow_execution_history(
+                "it-domain", "wf-lp", run_id,
+                first_event_id=known + 1,
+                wait_for_new_event=True, long_poll_timeout_s=5.0,
+            )
+            got["events"] = ev
+
+        th = threading.Thread(target=waiter2)
+        th.start()
+        time.sleep(0.1)
+        box.history_client.respond_decision_task_completed(
+            task.task_token, [], identity="w"
+        )
+        th.join(5.0)
+        assert not th.is_alive()
+        assert any(
+            e.event_type == EventType.DecisionTaskCompleted
+            for e in got["events"]
+        )
