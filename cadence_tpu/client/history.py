@@ -172,3 +172,10 @@ class HistoryClient:
             workflow_id, "reset_workflow_execution", domain_name,
             workflow_id, run_id, **kwargs
         )
+
+    def reset_sticky_task_list(self, domain_name, workflow_id, run_id="",
+                               **kwargs):
+        return self._call(
+            workflow_id, "reset_sticky_task_list", domain_name, workflow_id,
+            run_id, **kwargs
+        )

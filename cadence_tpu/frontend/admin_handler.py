@@ -1,0 +1,80 @@
+"""Admin API.
+
+Reference: service/frontend/adminHandler.go — operator-facing RPCs:
+DescribeHistoryHost (shard distribution), CloseShard, RemoveTask,
+raw history reads for replication debugging, and an admin
+DescribeWorkflowExecution exposing the shard id + raw mutable state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from cadence_tpu.runtime.api import BadRequestError, EntityNotExistsServiceError
+from cadence_tpu.runtime.persistence.errors import EntityNotExistsError
+from cadence_tpu.utils.hashing import shard_for_workflow
+
+
+class AdminHandler:
+    def __init__(self, history_service, domain_cache) -> None:
+        self.history = history_service
+        self.domains = domain_cache
+
+    def describe_history_host(self) -> Dict[str, Any]:
+        desc = self.history.describe()
+        desc["host"] = self.history.monitor.self_identity
+        return desc
+
+    def close_shard(self, shard_id: int) -> None:
+        """Force-release one shard (reference adminHandler.CloseShard)."""
+        self.history.controller.release_shard(shard_id)
+
+    def remove_task(self, shard_id: int, task_type: str, task_id: int,
+                    visibility_timestamp: int = 0) -> None:
+        """Surgically drop a poisoned queue task."""
+        execution = self.history.persistence.execution
+        if task_type == "transfer":
+            execution.complete_transfer_task(shard_id, task_id)
+        elif task_type == "timer":
+            execution.complete_timer_task(
+                shard_id, visibility_timestamp, task_id
+            )
+        elif task_type == "replication":
+            execution.complete_replication_task(shard_id, task_id)
+        else:
+            raise BadRequestError(f"unknown task type {task_type!r}")
+
+    def get_workflow_execution_raw_history(
+        self, domain_name: str, workflow_id: str, run_id: str,
+        start_event_id: int = 1, end_event_id: int = 1 << 60,
+    ):
+        """Raw batches + version-history items (replication debugging)."""
+        domain_id = self.domains.get_by_name(domain_name).info.id
+        return self.history.get_workflow_history_raw(
+            domain_id, workflow_id, run_id, start_event_id, end_event_id
+        )
+
+    def describe_workflow_execution(
+        self, domain_name: str, workflow_id: str, run_id: str = ""
+    ) -> Dict[str, Any]:
+        """Admin variant: shard id + raw mutable-state snapshot."""
+        domain_id = self.domains.get_by_name(domain_name).info.id
+        num_shards = self.history.controller.num_shards
+        shard_id = shard_for_workflow(workflow_id, num_shards)
+        engine = self.history.controller.get_engine_for_shard(shard_id)
+        if not run_id:
+            run_id = engine._current_run_id(domain_id, workflow_id)
+        try:
+            resp = engine.shard.persistence.execution.get_workflow_execution(
+                shard_id, domain_id, workflow_id, run_id
+            )
+        except EntityNotExistsError:
+            raise EntityNotExistsServiceError(
+                f"workflow {workflow_id}/{run_id} not found"
+            )
+        return {
+            "shard_id": shard_id,
+            "history_host": self.history.monitor.self_identity,
+            "mutable_state": resp.snapshot,
+            "next_event_id": resp.next_event_id,
+        }

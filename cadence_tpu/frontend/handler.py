@@ -1,0 +1,409 @@
+"""WorkflowHandler: the full public API surface.
+
+Reference: service/frontend/workflowHandler.go:247-2850 — every RPC
+validates (domain status, ID lengths, payload sizes), rate-limits per
+domain, resolves the domain, then delegates to the history/matching
+clients or the visibility store. Worker task-list APIs poll matching;
+visibility queries go to the visibility manager (advanced queries via
+the query translator in cadence_tpu.visibility).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.matching import PollRequest
+from cadence_tpu.runtime.api import (
+    BadRequestError,
+    Decision,
+    EntityNotExistsServiceError,
+    ServiceBusyError,
+    SignalRequest,
+    SignalWithStartRequest,
+    StartWorkflowRequest,
+)
+from cadence_tpu.runtime.domains import DomainCache
+from cadence_tpu.utils.quotas import MultiStageRateLimiter
+
+from .domain_handler import DomainHandler
+from .version_checker import ClientVersionChecker
+
+_MAX_ID_LENGTH = 1000  # reference workflowHandler maxIDLengthLimit
+_DEFAULT_BLOB_LIMIT = 2 * 1024 * 1024  # blobSizeLimitError default
+
+
+class WorkflowHandler:
+    def __init__(
+        self,
+        domain_handler: DomainHandler,
+        domain_cache: DomainCache,
+        history_client,
+        matching_client,
+        visibility=None,
+        rate_limiter: Optional[MultiStageRateLimiter] = None,
+        version_checker: Optional[ClientVersionChecker] = None,
+        blob_size_limit: int = _DEFAULT_BLOB_LIMIT,
+    ) -> None:
+        self.domain_handler = domain_handler
+        self.domains = domain_cache
+        self.history = history_client
+        self.matching = matching_client
+        self.visibility = visibility
+        self.limiter = rate_limiter or MultiStageRateLimiter(
+            global_rps=100000.0, domain_rps=lambda domain: 100000.0
+        )
+        self.versions = version_checker or ClientVersionChecker()
+
+    # -- request plumbing ----------------------------------------------
+
+    def _check(
+        self, domain_name: str,
+        client_impl: str = "", feature_version: str = "",
+    ) -> str:
+        """Common preamble: version gate, rate limit, domain resolution.
+        Returns the domain id."""
+        self.versions.check(client_impl, feature_version)
+        if not domain_name:
+            raise BadRequestError("domain is not set")
+        if len(domain_name) > _MAX_ID_LENGTH:
+            raise BadRequestError("domain name too long")
+        if not self.limiter.allow(domain_name):
+            raise ServiceBusyError(f"domain {domain_name} rate limit")
+        rec = self.domains.get_by_name(domain_name)
+        if rec.info.status != 0:
+            raise EntityNotExistsServiceError(
+                f"domain {domain_name} is deprecated"
+            )
+        return rec.info.id
+
+    def _check_id(self, value: str, what: str) -> None:
+        if not value:
+            raise BadRequestError(f"{what} is not set")
+        if len(value) > _MAX_ID_LENGTH:
+            raise BadRequestError(f"{what} exceeds {_MAX_ID_LENGTH} chars")
+
+    def _check_blob(self, payload: Optional[bytes], what: str) -> None:
+        if payload and len(payload) > _DEFAULT_BLOB_LIMIT:
+            raise BadRequestError(f"{what} exceeds the blob size limit")
+
+    # -- domain API ----------------------------------------------------
+
+    def register_domain(self, name: str, **kwargs) -> str:
+        return self.domain_handler.register_domain(name, **kwargs)
+
+    def describe_domain(self, name: str = "", id: str = ""):
+        return self.domain_handler.describe_domain(name=name, id=id)
+
+    def list_domains(self):
+        return self.domain_handler.list_domains()
+
+    def update_domain(self, name: str, **kwargs):
+        return self.domain_handler.update_domain(name, **kwargs)
+
+    def deprecate_domain(self, name: str) -> None:
+        self.domain_handler.deprecate_domain(name)
+
+    # -- workflow lifecycle --------------------------------------------
+
+    def start_workflow_execution(
+        self, request: StartWorkflowRequest, **headers
+    ) -> str:
+        self._check(request.domain, **headers)
+        self._check_id(request.workflow_id, "workflowId")
+        self._check_id(request.workflow_type, "workflowType")
+        self._check_id(request.task_list, "taskList")
+        self._check_blob(request.input, "input")
+        return self.history.start_workflow_execution(request)
+
+    def signal_workflow_execution(
+        self, request: SignalRequest, **headers
+    ) -> None:
+        self._check(request.domain, **headers)
+        self._check_id(request.workflow_id, "workflowId")
+        self._check_id(request.signal_name, "signalName")
+        self._check_blob(request.input, "signal input")
+        self.history.signal_workflow_execution(request)
+
+    def signal_with_start_workflow_execution(
+        self, request: SignalWithStartRequest, **headers
+    ) -> str:
+        self._check(request.start.domain, **headers)
+        self._check_id(request.start.workflow_id, "workflowId")
+        self._check_id(request.signal_name, "signalName")
+        self._check_blob(request.signal_input, "signal input")
+        return self.history.signal_with_start_workflow_execution(request)
+
+    def terminate_workflow_execution(
+        self, domain: str, workflow_id: str, run_id: str = "",
+        reason: str = "", details: bytes = b"", identity: str = "",
+        **headers,
+    ) -> None:
+        self._check(domain, **headers)
+        self._check_id(workflow_id, "workflowId")
+        self.history.terminate_workflow_execution(
+            domain, workflow_id, run_id,
+            reason=reason, details=details, identity=identity,
+        )
+
+    def request_cancel_workflow_execution(
+        self, domain: str, workflow_id: str, run_id: str = "",
+        identity: str = "", request_id: str = "", **headers,
+    ) -> None:
+        self._check(domain, **headers)
+        self._check_id(workflow_id, "workflowId")
+        self.history.request_cancel_workflow_execution(
+            domain, workflow_id, run_id,
+            identity=identity, request_id=request_id or str(uuid.uuid4()),
+        )
+
+    def reset_workflow_execution(
+        self, domain: str, workflow_id: str, run_id: str = "",
+        reason: str = "", decision_finish_event_id: int = 0,
+        request_id: str = "", **headers,
+    ) -> str:
+        self._check(domain, **headers)
+        self._check_id(workflow_id, "workflowId")
+        return self.history.reset_workflow_execution(
+            domain, workflow_id, run_id,
+            reason=reason,
+            decision_finish_event_id=decision_finish_event_id,
+            request_id=request_id,
+        )
+
+    def query_workflow(
+        self, domain: str, workflow_id: str, run_id: str = "",
+        query_type: str = "", query_args: bytes = b"",
+        timeout_s: float = 10.0, **headers,
+    ) -> bytes:
+        self._check(domain, **headers)
+        self._check_id(workflow_id, "workflowId")
+        self._check_id(query_type, "queryType")
+        return self.history.query_workflow(
+            domain, workflow_id, run_id,
+            query_type=query_type, query_args=query_args,
+            timeout_s=timeout_s,
+        )
+
+    def describe_workflow_execution(
+        self, domain: str, workflow_id: str, run_id: str = "", **headers
+    ):
+        self._check(domain, **headers)
+        self._check_id(workflow_id, "workflowId")
+        return self.history.describe_workflow_execution(
+            domain, workflow_id, run_id
+        )
+
+    def get_workflow_execution_history(
+        self, domain: str, workflow_id: str, run_id: str = "",
+        first_event_id: int = 1, page_size: int = 0, next_token: int = 0,
+        wait_for_new_event: bool = False, **headers,
+    ):
+        self._check(domain, **headers)
+        self._check_id(workflow_id, "workflowId")
+        return self.history.get_workflow_execution_history(
+            domain, workflow_id, run_id,
+            first_event_id=first_event_id, page_size=page_size,
+            next_token=next_token, wait_for_new_event=wait_for_new_event,
+        )
+
+    # -- worker APIs ---------------------------------------------------
+
+    def poll_for_decision_task(
+        self, domain: str, task_list: str, identity: str = "",
+        timeout_s: float = 1.0, **headers,
+    ):
+        domain_id = self._check(domain, **headers)
+        self._check_id(task_list, "taskList")
+        return self.matching.poll_for_decision_task(
+            PollRequest(domain_id, task_list, identity, timeout_s)
+        )
+
+    def poll_for_activity_task(
+        self, domain: str, task_list: str, identity: str = "",
+        timeout_s: float = 1.0, **headers,
+    ):
+        domain_id = self._check(domain, **headers)
+        self._check_id(task_list, "taskList")
+        return self.matching.poll_for_activity_task(
+            PollRequest(domain_id, task_list, identity, timeout_s)
+        )
+
+    def respond_decision_task_completed(
+        self, task_token: Dict[str, Any], decisions: List[Decision],
+        **kwargs,
+    ) -> None:
+        self.history.respond_decision_task_completed(
+            task_token, decisions, **kwargs
+        )
+
+    def respond_decision_task_failed(
+        self, task_token: Dict[str, Any], **kwargs
+    ) -> None:
+        self.history.respond_decision_task_failed(task_token, **kwargs)
+
+    def respond_activity_task_completed(self, task_token, **kwargs) -> None:
+        self._check_blob(kwargs.get("result"), "activity result")
+        self.history.respond_activity_task_completed(task_token, **kwargs)
+
+    def respond_activity_task_failed(self, task_token, **kwargs) -> None:
+        self.history.respond_activity_task_failed(task_token, **kwargs)
+
+    def respond_activity_task_canceled(self, task_token, **kwargs) -> None:
+        self.history.respond_activity_task_canceled(task_token, **kwargs)
+
+    def record_activity_task_heartbeat(self, task_token, **kwargs):
+        return self.history.record_activity_task_heartbeat(
+            task_token, **kwargs
+        )
+
+    # ByID variants (workflowHandler RespondActivityTaskCompletedByID
+    # etc.): resolve the task token from the pending-activity table
+    def _activity_token_by_id(
+        self, domain: str, workflow_id: str, run_id: str, activity_id: str
+    ) -> Dict[str, Any]:
+        domain_id = self.domains.get_by_name(domain).info.id
+        desc = self.history.describe_workflow_execution(
+            domain, workflow_id, run_id
+        )
+        for pa in desc.pending_activities:
+            if pa["activity_id"] == activity_id:
+                return {
+                    "domain_id": domain_id,
+                    "workflow_id": workflow_id,
+                    "run_id": run_id or desc.run_id,
+                    "schedule_id": pa["schedule_id"],
+                    "started_id": 0,
+                    "activity_id": activity_id,
+                }
+        raise EntityNotExistsServiceError(
+            f"activity {activity_id} not pending"
+        )
+
+    def respond_activity_task_completed_by_id(
+        self, domain: str, workflow_id: str, run_id: str,
+        activity_id: str, **kwargs,
+    ) -> None:
+        token = self._activity_token_by_id(
+            domain, workflow_id, run_id, activity_id
+        )
+        self.history.respond_activity_task_completed(token, **kwargs)
+
+    def respond_activity_task_failed_by_id(
+        self, domain: str, workflow_id: str, run_id: str,
+        activity_id: str, **kwargs,
+    ) -> None:
+        token = self._activity_token_by_id(
+            domain, workflow_id, run_id, activity_id
+        )
+        self.history.respond_activity_task_failed(token, **kwargs)
+
+    def respond_activity_task_canceled_by_id(
+        self, domain: str, workflow_id: str, run_id: str,
+        activity_id: str, **kwargs,
+    ) -> None:
+        token = self._activity_token_by_id(
+            domain, workflow_id, run_id, activity_id
+        )
+        self.history.respond_activity_task_canceled(token, **kwargs)
+
+    def record_activity_task_heartbeat_by_id(
+        self, domain: str, workflow_id: str, run_id: str,
+        activity_id: str, **kwargs,
+    ):
+        token = self._activity_token_by_id(
+            domain, workflow_id, run_id, activity_id
+        )
+        return self.history.record_activity_task_heartbeat(token, **kwargs)
+
+    def respond_query_task_completed(
+        self, task_list: str, query_id: str, result: bytes = b"",
+        error: str = "",
+    ) -> None:
+        self.matching.respond_query_task_completed(
+            task_list, query_id, result, error
+        )
+
+    def reset_sticky_task_list(
+        self, domain: str, workflow_id: str, run_id: str = "", **headers
+    ) -> None:
+        self._check(domain, **headers)
+        self.history.reset_sticky_task_list(domain, workflow_id, run_id)
+
+    def describe_task_list(
+        self, domain: str, task_list: str, task_type: int = 0, **headers
+    ):
+        domain_id = self._check(domain, **headers)
+        return self.matching.describe_task_list(
+            domain_id, task_list, task_type
+        )
+
+    # -- visibility ----------------------------------------------------
+
+    def _vis(self):
+        if self.visibility is None:
+            raise BadRequestError("visibility store not configured")
+        return self.visibility
+
+    def list_open_workflow_executions(
+        self, domain: str, page_size: int = 100, next_token: int = 0,
+        workflow_type: str = "", workflow_id: str = "",
+        earliest_start: int = 0, latest_start: int = 2**63 - 1, **headers,
+    ):
+        domain_id = self._check(domain, **headers)
+        return self._vis().list_open_workflow_executions(
+            domain_id, earliest_start, latest_start,
+            workflow_type, workflow_id, page_size, next_token,
+        )
+
+    def list_closed_workflow_executions(
+        self, domain: str, page_size: int = 100, next_token: int = 0,
+        workflow_type: str = "", workflow_id: str = "",
+        close_status: int = -1,
+        earliest_start: int = 0, latest_start: int = 2**63 - 1, **headers,
+    ):
+        domain_id = self._check(domain, **headers)
+        return self._vis().list_closed_workflow_executions(
+            domain_id, earliest_start, latest_start,
+            workflow_type, workflow_id, close_status, page_size, next_token,
+        )
+
+    def list_workflow_executions(
+        self, domain: str, query: str = "", page_size: int = 100,
+        next_token: int = 0, **headers,
+    ):
+        """Advanced visibility: SQL-like query string
+        (reference ListWorkflowExecutions + esql translation)."""
+        domain_id = self._check(domain, **headers)
+        vis = self._vis()
+        if hasattr(vis, "list_workflow_executions"):
+            return vis.list_workflow_executions(
+                domain_id, query, page_size, next_token
+            )
+        raise BadRequestError("advanced visibility not configured")
+
+    def scan_workflow_executions(
+        self, domain: str, query: str = "", page_size: int = 100,
+        next_token: int = 0, **headers,
+    ):
+        return self.list_workflow_executions(
+            domain, query, page_size, next_token, **headers
+        )
+
+    def count_workflow_executions(
+        self, domain: str, query: str = "", **headers
+    ) -> int:
+        domain_id = self._check(domain, **headers)
+        vis = self._vis()
+        if query and hasattr(vis, "count_workflow_executions_by_query"):
+            return vis.count_workflow_executions_by_query(domain_id, query)
+        return vis.count_workflow_executions(domain_id)
+
+    def get_search_attributes(self) -> Dict[str, str]:
+        """Valid search attribute keys (reference GetSearchAttributes)."""
+        from cadence_tpu.visibility.search_attributes import (
+            DEFAULT_SEARCH_ATTRIBUTES,
+        )
+
+        return dict(DEFAULT_SEARCH_ATTRIBUTES)

@@ -290,11 +290,32 @@ class MatchingEngine:
         with self._query_lock:
             self._pending_queries[query_id] = (done, slot)
         try:
-            part = self._pick_partition(domain_id, task_list, write=True)
-            mgr = self._get_manager(
-                TaskListID(domain_id, part, TASK_TYPE_DECISION)
-            )
-            if not mgr.matcher.offer(task, timeout=timeout_s / 2):
+            # try every partition (pollers may be parked on any sibling —
+            # a single random pick would miss them)
+            n_parts = max(1, self._n_read_partitions(
+                domain=domain_id, task_list=task_list
+            ))
+            names = [
+                TaskListID.partition_name(task_list, i)
+                for i in range(n_parts)
+            ] if not TaskListID("", task_list, 0).is_partition else [task_list]
+            deadline = time.monotonic() + timeout_s / 2
+            offered = False
+            while not offered:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                per_try = max(0.05, remaining / (2 * len(names)))
+                for part in names:
+                    mgr = self._get_manager(
+                        TaskListID(domain_id, part, TASK_TYPE_DECISION)
+                    )
+                    if mgr.matcher.offer(task, timeout=min(per_try, max(
+                        0.0, deadline - time.monotonic()
+                    ))):
+                        offered = True
+                        break
+            if not offered:
                 raise QueryFailedError(
                     f"no poller on task list {task_list} to answer query"
                 )

@@ -143,3 +143,11 @@ class ShardController:
             for handle in self._handles.values():
                 handle.stop()
             self._handles.clear()
+
+    def release_shard(self, shard_id: int) -> None:
+        """Force-release one owned shard (admin CloseShard — reference
+        shardController.removeEngineForShard)."""
+        with self._lock:
+            handle = self._handles.pop(shard_id, None)
+        if handle is not None:
+            handle.stop()

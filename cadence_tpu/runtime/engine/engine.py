@@ -460,10 +460,6 @@ class HistoryEngine:
         workflow_id = task_token["workflow_id"]
         run_id = task_token["run_id"]
         schedule_id = task_token["schedule_id"]
-        if query_results:
-            self.query_registry.complete(
-                domain_id, workflow_id, run_id, query_results
-            )
 
         def action(ctx, ms):
             ei = ms.execution_info
@@ -513,7 +509,7 @@ class HistoryEngine:
             if not handler.workflow_closed and (
                 handler.unhandled_close_dropped
                 or self._needs_new_decision(txn, completed.event_id)
-                or self.query_registry.pending_count(
+                or self.query_registry.buffered_count(
                     domain_id, workflow_id, run_id
                 ) > 0
             ):
@@ -521,8 +517,17 @@ class HistoryEngine:
             result = txn.close()
             ctx.update_workflow(ms, result)
             self._notify(result)
+            committed.append(True)
 
+        committed: List[bool] = []
         self._update_workflow(domain_id, workflow_id, run_id, action)
+        # consistent-query answers apply only when the completion actually
+        # committed — a stale/failed completion must not answer queries
+        # with state that never took effect
+        if committed and query_results:
+            self.query_registry.complete(
+                domain_id, workflow_id, run_id, query_results
+            )
 
     @staticmethod
     def _needs_new_decision(txn, completed_id: int) -> bool:
@@ -1075,14 +1080,24 @@ class HistoryEngine:
             q = self.query_registry.buffer(
                 domain_id, workflow_id, run_id, query_type, query_args
             )
-            if not q.wait(timeout_s):
-                self.query_registry.fail(
-                    domain_id, workflow_id, run_id, q, "query timed out"
-                )
-                raise QueryFailedError("query timed out")
-            if q.error:
-                raise QueryFailedError(q.error)
-            return q.result or b""
+            # the decision may have completed between the probe and the
+            # buffer (its buffered-query check then saw nothing): re-probe
+            # and fall through to the direct path if the workflow is idle
+            _, still_pending, task_list = self._update_workflow(
+                domain_id, workflow_id, run_id, probe
+            )
+            if still_pending:
+                if not q.wait(timeout_s):
+                    self.query_registry.fail(
+                        domain_id, workflow_id, run_id, q, "query timed out"
+                    )
+                    raise QueryFailedError("query timed out")
+                if q.error:
+                    raise QueryFailedError(q.error)
+                return q.result or b""
+            self.query_registry.fail(
+                domain_id, workflow_id, run_id, q, "rerouted to direct path"
+            )
 
         if self.matching_client is None:
             raise InternalServiceError("matching client not wired for query")
@@ -1114,3 +1129,17 @@ class HistoryEngine:
             domain_id, workflow_id, run_id, reason,
             decision_finish_event_id, request_id, identity,
         )
+
+    def reset_sticky_task_list(
+        self, domain_name: str, workflow_id: str, run_id: str = ""
+    ) -> None:
+        """Clear sticky execution attributes (frontend ResetStickyTaskList
+        → historyEngine.ResetStickyTaskList)."""
+        domain_id = self.domains.get_by_name(domain_name).info.id
+
+        def action(ctx, ms):
+            ms.clear_stickiness()
+            txn = self._txn(ctx, ms, ms.current_version)
+            ctx.update_workflow(ms, txn.close())
+
+        self._update_workflow(domain_id, workflow_id, run_id, action)

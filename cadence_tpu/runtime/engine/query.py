@@ -67,18 +67,34 @@ class QueryRegistry:
     def take_buffered(
         self, domain_id: str, workflow_id: str, run_id: str
     ) -> List[QueryState]:
-        """Move buffered queries to started; returns them for attachment
-        to a decision task dispatch."""
+        """Queries to attach to a decision task dispatch: buffered ones
+        move to started; already-started-but-unanswered ones are
+        RE-attached (the worker that first carried them may have died —
+        re-delivery keeps them answerable until the caller times out)."""
         key = (domain_id, workflow_id, run_id)
         with self._lock:
             out = [
                 q
                 for q in self._queries.get(key, [])
-                if q.state == QueryStateName.BUFFERED
+                if q.state != QueryStateName.COMPLETED
             ]
             for q in out:
                 q.start()
         return out
+
+    def buffered_count(
+        self, domain_id: str, workflow_id: str, run_id: str
+    ) -> int:
+        """Queries not yet attached to any decision dispatch — the count
+        that justifies scheduling a fresh decision task."""
+        with self._lock:
+            return sum(
+                1
+                for q in self._queries.get(
+                    (domain_id, workflow_id, run_id), []
+                )
+                if q.state == QueryStateName.BUFFERED
+            )
 
     def complete(
         self, domain_id: str, workflow_id: str, run_id: str,
@@ -126,23 +142,6 @@ class QueryRegistry:
         with self._lock:
             for q in self._queries.pop(key, []):
                 q.complete(None, error)
-
-    def requeue(
-        self, domain_id: str, workflow_id: str, run_id: str,
-        queries: List[QueryState],
-    ) -> None:
-        """Return started-but-undelivered queries to the buffered state
-        (a condition-retried dispatch must not lose them)."""
-        key = (domain_id, workflow_id, run_id)
-        with self._lock:
-            pending = self._queries.get(key, [])
-            for q in queries:
-                if q.state == QueryStateName.STARTED:
-                    q.state = QueryStateName.BUFFERED
-                    if q not in pending:
-                        pending.append(q)
-            if pending:
-                self._queries[key] = pending
 
     def pending_count(
         self, domain_id: str, workflow_id: str, run_id: str

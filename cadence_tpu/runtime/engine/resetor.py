@@ -87,9 +87,17 @@ class WorkflowResetor:
                     identity=identity,
                     details=reason.encode(),
                 )
-            for sig in self._signals_after(
+            # post-cut signals come from persisted history AND from the
+            # old run's buffered events (signals held behind an in-flight
+            # decision are not yet in history but must survive the reset)
+            carried = self._signals_after(
                 base_events, decision_finish_event_id
-            ):
+            ) + [
+                e
+                for e in ms.buffered_events
+                if e.event_type == EventType.WorkflowExecutionSignaled
+            ]
+            for sig in carried:
                 a = sig.attributes
                 txn.add_workflow_execution_signaled(
                     a.get("signal_name", ""), a.get("input", b""),

@@ -1,0 +1,109 @@
+"""Persistence decorator clients: metrics + rate limiting.
+
+Reference: common/persistence/persistenceMetricClients.go (per-API
+latency/error counters around every manager) and
+persistenceRateLimitedClients.go (token-bucket QPS guards returning
+ServiceBusyError when saturated). Decorators are generic: they wrap any
+manager object and intercept its public methods, so one implementation
+covers all five managers — the factory stacks them the same way the
+reference's persistence-factory does.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from cadence_tpu.utils.metrics import NOOP, Scope
+from cadence_tpu.utils.quotas import TokenBucket
+
+
+class PersistenceBusyError(Exception):
+    """QPS limit hit (reference: ServiceBusyError from rate-limited
+    persistence clients)."""
+
+
+class _Wrapped:
+    """Base proxy: public methods pass through hooks."""
+
+    def __init__(self, base: Any) -> None:
+        self._base = base
+
+    def _invoke(self, name: str, method, args, kwargs):
+        return method(*args, **kwargs)
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._base, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+
+        def call(*args, **kwargs):
+            return self._invoke(name, attr, args, kwargs)
+
+        return call
+
+
+class MetricsClient(_Wrapped):
+    """Latency + error counters per persistence API."""
+
+    def __init__(self, base: Any, metrics: Scope = NOOP,
+                 manager: str = "") -> None:
+        super().__init__(base)
+        self._metrics = metrics.tagged(
+            layer="persistence", manager=manager or type(base).__name__
+        )
+
+    def _invoke(self, name, method, args, kwargs):
+        start = time.monotonic()
+        try:
+            out = method(*args, **kwargs)
+        except Exception as e:
+            self._metrics.inc(f"{name}.errors")
+            self._metrics.inc(f"{name}.errors.{type(e).__name__}")
+            raise
+        finally:
+            self._metrics.record(
+                f"{name}.latency", time.monotonic() - start
+            )
+        self._metrics.inc(f"{name}.calls")
+        return out
+
+
+class RateLimitedClient(_Wrapped):
+    """Token-bucket QPS guard in front of a manager."""
+
+    def __init__(self, base: Any, max_qps: float = 2000.0,
+                 bucket: Optional[TokenBucket] = None) -> None:
+        super().__init__(base)
+        self._bucket = bucket or TokenBucket(max_qps)
+
+    def _invoke(self, name, method, args, kwargs):
+        if not self._bucket.allow():
+            raise PersistenceBusyError(
+                f"persistence QPS limit hit on {name}"
+            )
+        return method(*args, **kwargs)
+
+
+def wrap_bundle(bundle, metrics: Scope = NOOP,
+                max_qps: Optional[float] = None):
+    """Layer metrics (and optionally rate limits) over every manager in
+    a PersistenceBundle, mirroring persistence-factory/factory.go."""
+    from .interfaces import PersistenceBundle
+
+    def deco(mgr, name):
+        if mgr is None:
+            return None
+        out = MetricsClient(mgr, metrics, manager=name)
+        if max_qps is not None:
+            out = RateLimitedClient(out, max_qps)
+        return out
+
+    return PersistenceBundle(
+        shard=deco(bundle.shard, "shard"),
+        execution=deco(bundle.execution, "execution"),
+        history=deco(bundle.history, "history"),
+        task=deco(bundle.task, "task"),
+        metadata=deco(bundle.metadata, "metadata"),
+        visibility=deco(bundle.visibility, "visibility"),
+    )

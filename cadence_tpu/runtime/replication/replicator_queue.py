@@ -101,8 +101,10 @@ class ReplicatorQueueProcessor:
             branch = BranchToken.from_json(
                 task.new_run_branch_token.decode()
             )
+            # page_size=1 bounds the read to the first batch node — the
+            # continued run may have grown arbitrarily since
             batches, _ = self.shard.persistence.history.read_history_branch(
-                branch, 1, 1 << 60
+                branch, 1, 1 << 60, page_size=1
             )
             new_run_events = list(batches[0]) if batches else []
             if new_run_events:

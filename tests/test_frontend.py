@@ -1,0 +1,409 @@
+"""Frontend gateway tests: domain CRUD/failover/archival, the public
+workflow API with validation + rate limiting, visibility queries, DC
+redirection, version gate.
+
+Reference strategies: host/integration_test.go (API through frontend),
+common/domain/handler_test.go, dcRedirectionPolicy_test.go.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+from cadence_tpu.core.enums import DecisionType, EventType
+from cadence_tpu.frontend import (
+    AdminHandler,
+    ArchivalStatus,
+    ClientVersionChecker,
+    ClientVersionNotSupportedError,
+    DCRedirectionHandler,
+    DomainAlreadyExistsError,
+    DomainHandler,
+    WorkflowHandler,
+)
+from cadence_tpu.matching import MatchingEngine
+from cadence_tpu.messaging import MessageBus
+from cadence_tpu.runtime.api import (
+    BadRequestError,
+    Decision,
+    ServiceBusyError,
+    SignalRequest,
+    StartWorkflowRequest,
+)
+from cadence_tpu.runtime.domains import DomainCache
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.service import HistoryService
+from cadence_tpu.utils.quotas import MultiStageRateLimiter
+from cadence_tpu.visibility import AdvancedVisibilityStore
+
+
+def _meta(current="active"):
+    return ClusterMetadata(
+        failover_version_increment=10,
+        master_cluster_name="active",
+        current_cluster_name=current,
+        cluster_info={
+            "active": ClusterInformation(initial_failover_version=1),
+            "standby": ClusterInformation(initial_failover_version=2),
+        },
+    )
+
+
+class FrontendBox:
+    """Onebox with the real frontend in front."""
+
+    def __init__(self, cluster="active", limiter=None):
+        self.persistence = create_memory_bundle()
+        self.bus = MessageBus()
+        self.meta = _meta(cluster)
+        self.domain_handler = DomainHandler(
+            self.persistence.metadata, self.meta,
+            replication_producer=self.bus.new_producer("domain-replication"),
+        )
+        self.domains = DomainCache(self.persistence.metadata)
+        self.history = HistoryService(
+            2, self.persistence, self.domains,
+            single_host_monitor(f"{cluster}-host"),
+            cluster_metadata=self.meta,
+        )
+        self.history_client = HistoryClient(self.history.controller)
+        self.matching = MatchingEngine(self.persistence.task, self.history_client)
+        self.matching_client = MatchingClient(self.matching)
+        self.history.wire(self.matching_client, self.history_client)
+        self.history.start()
+        self.frontend = WorkflowHandler(
+            self.domain_handler, self.domains,
+            self.history_client, self.matching_client,
+            visibility=AdvancedVisibilityStore(self.persistence.visibility),
+            rate_limiter=limiter,
+        )
+        self.admin = AdminHandler(self.history, self.domains)
+
+    def stop(self):
+        self.history.stop()
+        self.matching.shutdown()
+
+
+@pytest.fixture()
+def fb():
+    b = FrontendBox()
+    b.domain_handler.register_domain("fe-domain")
+    yield b
+    b.stop()
+
+
+class TestDomainHandler:
+    def test_register_describe_list(self, fb):
+        fb.domain_handler.register_domain(
+            "dom-a", description="d", retention_days=3
+        )
+        rec = fb.frontend.describe_domain(name="dom-a")
+        assert rec.config.retention_days == 3
+        names = [r.info.name for r in fb.frontend.list_domains()]
+        assert "dom-a" in names and "fe-domain" in names
+
+    def test_duplicate_register_rejected(self, fb):
+        with pytest.raises(DomainAlreadyExistsError):
+            fb.domain_handler.register_domain("fe-domain")
+
+    def test_invalid_names_rejected(self, fb):
+        for bad in ("", "-leading", "has space", "x" * 300):
+            with pytest.raises(BadRequestError):
+                fb.domain_handler.register_domain(bad)
+
+    def test_archival_state_machine(self, fb):
+        fb.domain_handler.register_domain(
+            "dom-arch", history_archival_status=ArchivalStatus.ENABLED,
+            history_archival_uri="file:///tmp/arch",
+        )
+        # URI immutable
+        with pytest.raises(BadRequestError):
+            fb.domain_handler.update_domain(
+                "dom-arch", history_archival_uri="file:///other"
+            )
+        # disable keeps URI
+        rec = fb.domain_handler.update_domain(
+            "dom-arch", history_archival_status=ArchivalStatus.DISABLED
+        )
+        assert rec.config.history_archival_status == ArchivalStatus.DISABLED
+        assert rec.config.history_archival_uri == "file:///tmp/arch"
+        # enabling without URI fails
+        with pytest.raises(BadRequestError):
+            fb.domain_handler.register_domain(
+                "dom-arch2", history_archival_status=ArchivalStatus.ENABLED
+            )
+
+    def test_global_domain_failover_bumps_version(self, fb):
+        fb.domain_handler.register_domain(
+            "dom-g", is_global=True, clusters=["active", "standby"],
+            active_cluster="active",
+        )
+        before = fb.frontend.describe_domain(name="dom-g")
+        assert before.failover_version == 1  # active's initial version
+        after = fb.domain_handler.failover_domain("dom-g", "standby")
+        assert after.replication_config.active_cluster_name == "standby"
+        assert after.failover_version > before.failover_version
+        assert after.failover_version % 10 == 2  # owned by standby
+
+    def test_bad_binaries(self, fb):
+        fb.domain_handler.update_domain(
+            "fe-domain",
+            add_bad_binary={"checksum": "abc123", "reason": "bad deploy"},
+        )
+        rec = fb.frontend.describe_domain(name="fe-domain")
+        assert "abc123" in rec.config.bad_binaries
+        fb.domain_handler.update_domain(
+            "fe-domain", remove_bad_binary="abc123"
+        )
+        rec = fb.frontend.describe_domain(name="fe-domain")
+        assert "abc123" not in rec.config.bad_binaries
+
+    def test_domain_replication_record_applies_on_peer(self, fb):
+        fb.domain_handler.register_domain(
+            "dom-repl", is_global=True, clusters=["active", "standby"],
+        )
+        peer = FrontendBox("standby")
+        try:
+            consumer = fb.bus.new_consumer("domain-replication", "standby")
+            n = consumer.drain(
+                lambda m: peer.domain_handler.apply_replication_record(m.value)
+            )
+            assert n >= 1
+            rec = peer.domain_handler.describe_domain(name="dom-repl")
+            assert rec.is_global
+            assert rec.info.id == (
+                fb.frontend.describe_domain(name="dom-repl").info.id
+            )
+        finally:
+            peer.stop()
+
+
+class TestWorkflowAPI:
+    def test_full_workflow_through_frontend(self, fb):
+        run_id = fb.frontend.start_workflow_execution(
+            StartWorkflowRequest(
+                domain="fe-domain", workflow_id="fe-wf",
+                workflow_type="echo", task_list="fe-tl",
+                execution_start_to_close_timeout_seconds=60,
+            )
+        )
+        task = fb.frontend.poll_for_decision_task(
+            "fe-domain", "fe-tl", identity="w", timeout_s=5.0
+        )
+        assert task is not None
+        fb.frontend.respond_decision_task_completed(
+            task.task_token,
+            [Decision(DecisionType.CompleteWorkflowExecution,
+                      {"result": b"ok"})],
+        )
+        desc = fb.frontend.describe_workflow_execution(
+            "fe-domain", "fe-wf", run_id
+        )
+        assert not desc.is_running
+        events, _ = fb.frontend.get_workflow_execution_history(
+            "fe-domain", "fe-wf", run_id
+        )
+        assert events[-1].event_type == EventType.WorkflowExecutionCompleted
+
+    def test_validation(self, fb):
+        with pytest.raises(BadRequestError):
+            fb.frontend.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain="fe-domain", workflow_id="x" * 1001,
+                    workflow_type="t", task_list="tl",
+                    execution_start_to_close_timeout_seconds=60,
+                )
+            )
+        with pytest.raises(BadRequestError):
+            fb.frontend.signal_workflow_execution(
+                SignalRequest(domain="", workflow_id="w", signal_name="s")
+            )
+
+    def test_rate_limit(self):
+        box = FrontendBox(
+            limiter=MultiStageRateLimiter(
+                global_rps=2.0, domain_rps=lambda d: 2.0
+            )
+        )
+        try:
+            box.domain_handler.register_domain("rl-dom")
+            ok = denied = 0
+            for _ in range(40):
+                try:
+                    box.frontend.describe_domain_rpc_stub = None
+                    box.frontend.list_open_workflow_executions("rl-dom")
+                    ok += 1
+                except ServiceBusyError:
+                    denied += 1
+            assert denied > 0 and ok >= 1
+        finally:
+            box.stop()
+
+    def test_version_gate(self, fb):
+        with pytest.raises(ClientVersionNotSupportedError):
+            fb.frontend.describe_workflow_execution(
+                "fe-domain", "w",
+                client_impl="uber-go", feature_version="1.0.0",
+            )
+
+
+class TestVisibility:
+    def _seed(self, fb):
+        for i in range(3):
+            fb.frontend.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain="fe-domain", workflow_id=f"vis-{i}",
+                    workflow_type="typeA" if i < 2 else "typeB",
+                    task_list="vis-tl",
+                    execution_start_to_close_timeout_seconds=60,
+                )
+            )
+        assert fb.history.drain_queues()
+        # complete one of them
+        task = fb.frontend.poll_for_decision_task(
+            "fe-domain", "vis-tl", timeout_s=5.0
+        )
+        fb.frontend.respond_decision_task_completed(
+            task.task_token,
+            [Decision(DecisionType.CompleteWorkflowExecution, {})],
+        )
+        assert fb.history.drain_queues()
+
+    def test_list_open_closed(self, fb):
+        self._seed(fb)
+        open_recs, _ = fb.frontend.list_open_workflow_executions("fe-domain")
+        closed_recs, _ = fb.frontend.list_closed_workflow_executions(
+            "fe-domain"
+        )
+        assert len(open_recs) == 2
+        assert len(closed_recs) == 1
+
+    def test_query_language(self, fb):
+        self._seed(fb)
+        recs, _ = fb.frontend.list_workflow_executions(
+            "fe-domain", "WorkflowType = 'typeA'"
+        )
+        assert len(recs) == 2
+        recs, _ = fb.frontend.list_workflow_executions(
+            "fe-domain", "WorkflowType = 'typeA' AND CloseStatus = 'COMPLETED'"
+        )
+        assert len(recs) == 1
+        recs, _ = fb.frontend.list_workflow_executions(
+            "fe-domain",
+            "StartTime > 0 ORDER BY StartTime DESC",
+        )
+        assert len(recs) == 3
+        assert recs[0].start_time >= recs[-1].start_time
+        n = fb.frontend.count_workflow_executions(
+            "fe-domain", "WorkflowType = 'typeB'"
+        )
+        assert n == 1
+
+    def test_search_attributes_listed(self, fb):
+        attrs = fb.frontend.get_search_attributes()
+        assert "WorkflowType" in attrs and "CustomIntField" in attrs
+
+
+class TestDCRedirection:
+    def test_passive_domain_forwards_to_active(self):
+        active = FrontendBox("active")
+        standby = FrontendBox("standby")
+        try:
+            domain_id = active.domain_handler.register_domain(
+                "dc-dom", is_global=True,
+                clusters=["active", "standby"], active_cluster="active",
+            )
+            standby.domain_handler.register_domain(
+                "dc-dom", is_global=True,
+                clusters=["active", "standby"], active_cluster="active",
+                domain_id=active.frontend.describe_domain(
+                    name="dc-dom"
+                ).info.id,
+                failover_version=1,
+            )
+            redirect = DCRedirectionHandler(
+                standby.frontend, "standby",
+                remote_frontends={"active": active.frontend},
+            )
+            run_id = redirect.call(
+                "start_workflow_execution", "dc-dom",
+                StartWorkflowRequest(
+                    domain="dc-dom", workflow_id="dc-wf",
+                    workflow_type="t", task_list="tl",
+                    execution_start_to_close_timeout_seconds=60,
+                ),
+            )
+            # started on the ACTIVE cluster, not locally
+            desc = active.frontend.describe_workflow_execution(
+                "dc-dom", "dc-wf", run_id
+            )
+            assert desc.is_running
+        finally:
+            active.stop()
+            standby.stop()
+
+
+class TestAdmin:
+    def test_describe_history_host_and_close_shard(self, fb):
+        desc = fb.admin.describe_history_host()
+        assert desc["shard_count"] == 2
+        fb.admin.close_shard(0)
+        desc = fb.admin.describe_history_host()
+        assert desc["shard_count"] == 1
+
+    def test_admin_describe_workflow(self, fb):
+        run_id = fb.frontend.start_workflow_execution(
+            StartWorkflowRequest(
+                domain="fe-domain", workflow_id="adm-wf",
+                workflow_type="t", task_list="tl",
+                execution_start_to_close_timeout_seconds=60,
+            )
+        )
+        out = fb.admin.describe_workflow_execution(
+            "fe-domain", "adm-wf", run_id
+        )
+        assert out["next_event_id"] >= 3
+        assert "execution_info" in out["mutable_state"]
+
+
+class TestPersistenceDecorators:
+    def test_metrics_and_rate_limit_wrappers(self):
+        from cadence_tpu.runtime.persistence.decorators import (
+            PersistenceBusyError,
+            wrap_bundle,
+        )
+        from cadence_tpu.utils.metrics import Scope
+
+        scope = Scope()
+        bundle = wrap_bundle(create_memory_bundle(), metrics=scope)
+        # calls pass through and are counted
+        from cadence_tpu.runtime.persistence.records import (
+            DomainConfig, DomainInfo, DomainRecord, DomainReplicationConfig,
+        )
+        rec = DomainRecord(
+            info=DomainInfo(id="d1", name="deco-dom"),
+            config=DomainConfig(),
+            replication_config=DomainReplicationConfig(),
+        )
+        bundle.metadata.create_domain(rec)
+        assert bundle.metadata.get_domain(name="deco-dom").info.id == "d1"
+        counters = scope.registry.snapshot()["counters"]
+        assert any(
+            k.startswith("create_domain.calls") and v == 1
+            for k, v in counters.items()
+        ), counters
+
+        # rate-limited wrapper throttles
+        throttled = wrap_bundle(
+            create_memory_bundle(), metrics=scope, max_qps=1.0
+        )
+        throttled.metadata.list_domains()
+        with pytest.raises(PersistenceBusyError):
+            for _ in range(50):
+                throttled.metadata.list_domains()
