@@ -1,0 +1,170 @@
+"""File-backed archivers.
+
+Reference: common/archiver/filestore/historyArchiver.go +
+visibilityArchiver.go — archives land as JSON files under the URI path:
+``<path>/<domain_id>/<workflow_id>/<run_id>/history.json`` and
+``<path>/<domain_id>/visibility/<workflow_id>.<run_id>.json``. Writes
+are atomic (tmp + rename) and idempotent (archival retries overwrite
+with identical content).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import List, Tuple
+from urllib.parse import quote
+
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.runtime.persistence.records import VisibilityRecord
+from cadence_tpu.visibility.query import compile_query
+
+from .interfaces import (
+    ArchiveHistoryRequest,
+    ArchiveVisibilityRequest,
+    HistoryArchiver,
+    VisibilityArchiver,
+)
+from .uri import URI, InvalidURIError
+
+
+def _safe(component: str) -> str:
+    """Workflow/run ids are caller-controlled; percent-encode every path
+    separator (and '.') so ids like '../../x' cannot escape the archive
+    root (the reference filestore encodes these components too)."""
+    return quote(component, safe="") or "_"
+
+
+def _atomic_write(path: str, data: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class FilestoreHistoryArchiver(HistoryArchiver):
+    def validate_uri(self, uri: URI) -> None:
+        if uri.scheme != "file" or not uri.path:
+            raise InvalidURIError(f"filestore needs file://<dir>, got {uri}")
+
+    def _path(self, uri: URI, domain_id, workflow_id, run_id) -> str:
+        return os.path.join(
+            uri.path, _safe(domain_id), _safe(workflow_id), _safe(run_id),
+            "history.json",
+        )
+
+    def archive(
+        self, uri: URI, request: ArchiveHistoryRequest,
+        batches: List[List[HistoryEvent]],
+    ) -> None:
+        self.validate_uri(uri)
+        payload = {
+            "domain_id": request.domain_id,
+            "domain_name": request.domain_name,
+            "workflow_id": request.workflow_id,
+            "run_id": request.run_id,
+            "close_failover_version": request.close_failover_version,
+            "batches": [[e.to_dict() for e in b] for b in batches],
+        }
+        _atomic_write(
+            self._path(uri, request.domain_id, request.workflow_id,
+                       request.run_id),
+            json.dumps(payload),
+        )
+
+    def get(
+        self, uri: URI, domain_id: str, workflow_id: str, run_id: str,
+        page_size: int = 0, next_token: int = 0,
+    ) -> Tuple[List[List[HistoryEvent]], int]:
+        self.validate_uri(uri)
+        path = self._path(uri, domain_id, workflow_id, run_id)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no archived history for {workflow_id}/{run_id}"
+            )
+        with open(path) as f:
+            payload = json.load(f)
+        batches = [
+            [HistoryEvent.from_dict(d) for d in b]
+            for b in payload["batches"]
+        ]
+        if page_size:
+            page = batches[next_token : next_token + page_size]
+            token = next_token + len(page)
+            return page, (token if token < len(batches) else 0)
+        return batches, 0
+
+
+class FilestoreVisibilityArchiver(VisibilityArchiver):
+    def validate_uri(self, uri: URI) -> None:
+        if uri.scheme != "file" or not uri.path:
+            raise InvalidURIError(f"filestore needs file://<dir>, got {uri}")
+
+    def _dir(self, uri: URI, domain_id: str) -> str:
+        return os.path.join(uri.path, _safe(domain_id), "visibility")
+
+    def archive(self, uri: URI, request: ArchiveVisibilityRequest) -> None:
+        self.validate_uri(uri)
+        payload = {
+            "domain_id": request.domain_id,
+            "workflow_id": request.workflow_id,
+            "run_id": request.run_id,
+            "workflow_type": request.workflow_type,
+            "start_time": request.start_time,
+            "execution_time": request.execution_time,
+            "close_time": request.close_time,
+            "close_status": request.close_status,
+            "history_length": request.history_length,
+            "search_attributes": {
+                k: v for k, v in request.search_attributes.items()
+                if isinstance(v, (str, int, float, bool))
+            },
+        }
+        _atomic_write(
+            os.path.join(
+                self._dir(uri, request.domain_id),
+                f"{_safe(request.workflow_id)}.{_safe(request.run_id)}.json",
+            ),
+            json.dumps(payload),
+        )
+
+    def query(
+        self, uri: URI, domain_id: str, query: str = "",
+        page_size: int = 100, next_token: int = 0,
+    ) -> Tuple[List[VisibilityRecord], int]:
+        self.validate_uri(uri)
+        d = self._dir(uri, domain_id)
+        records: List[VisibilityRecord] = []
+        if os.path.isdir(d):
+            for name in sorted(os.listdir(d)):
+                if not name.endswith(".json"):
+                    continue
+                with open(os.path.join(d, name)) as f:
+                    p = json.load(f)
+                records.append(
+                    VisibilityRecord(
+                        domain_id=p["domain_id"],
+                        workflow_id=p["workflow_id"],
+                        run_id=p["run_id"],
+                        workflow_type=p.get("workflow_type", ""),
+                        start_time=p.get("start_time", 0),
+                        execution_time=p.get("execution_time", 0),
+                        close_time=p.get("close_time", 0),
+                        close_status=p.get("close_status", 0),
+                        history_length=p.get("history_length", 0),
+                        search_attributes=p.get("search_attributes", {}),
+                    )
+                )
+        matched = compile_query(query).apply(records)
+        page = matched[next_token : next_token + page_size]
+        token = next_token + len(page)
+        return page, (token if token < len(matched) else 0)

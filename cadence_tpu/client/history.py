@@ -68,7 +68,8 @@ class HistoryClient:
 
     def signal_with_start_workflow_execution(self, request):
         return self._call(
-            request.workflow_id, "signal_with_start_workflow_execution",
+            request.start.workflow_id,
+            "signal_with_start_workflow_execution",
             request,
         )
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import bisect
 import copy
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -498,6 +499,15 @@ class MemoryHistoryManager(I.HistoryManager):
                 for t in self._branches.get(tree_id, {}).values()
             ]
 
+    def list_history_trees(self):
+        """All (tree_id, branches) pairs — the history scavenger's scan
+        surface (reference: GetAllHistoryTreeBranches)."""
+        with self._lock:
+            return [
+                (tree_id, [copy.deepcopy(t) for t in branches.values()])
+                for tree_id, branches in self._branches.items()
+            ]
+
 
 class MemoryTaskManager(I.TaskManager):
     def __init__(self) -> None:
@@ -517,6 +527,7 @@ class MemoryTaskManager(I.TaskManager):
                 )
             info = copy.deepcopy(info)
             info.range_id += 1
+            info.last_updated = time.time_ns()
             self._lists[key] = copy.deepcopy(info)
             return info
 
@@ -526,6 +537,7 @@ class MemoryTaskManager(I.TaskManager):
             stored = self._lists.get(key)
             if stored is None or stored.range_id != info.range_id:
                 raise TaskListLeaseLostError(info.name)
+            info.last_updated = time.time_ns()
             self._lists[key] = copy.deepcopy(info)
 
     def create_tasks(

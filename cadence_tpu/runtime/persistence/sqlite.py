@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import sqlite3
+import time
 import threading
 import uuid
 from contextlib import contextmanager
@@ -604,20 +605,25 @@ class SqliteTaskManager(I.TaskManager):
                 "task_lists WHERE domain_id=? AND name=? AND task_type=?",
                 (domain_id, name, task_type),
             ).fetchone()
+            now_ns = time.time_ns()
             if row:
                 info = TaskListInfo(
-                    domain_id, name, task_type, row[0] + 1, row[1], row[2], row[3]
+                    domain_id, name, task_type, row[0] + 1, row[1], row[2],
+                    now_ns,
                 )
                 c.execute(
-                    "UPDATE task_lists SET range_id=? WHERE domain_id=? AND "
-                    "name=? AND task_type=?",
-                    (info.range_id, domain_id, name, task_type),
+                    "UPDATE task_lists SET range_id=?, last_updated=? "
+                    "WHERE domain_id=? AND name=? AND task_type=?",
+                    (info.range_id, now_ns, domain_id, name, task_type),
                 )
             else:
-                info = TaskListInfo(domain_id, name, task_type, range_id=1)
+                info = TaskListInfo(
+                    domain_id, name, task_type, range_id=1,
+                    last_updated=now_ns,
+                )
                 c.execute(
                     "INSERT INTO task_lists VALUES (?,?,?,?,?,?,?)",
-                    (domain_id, name, task_type, 1, 0, 0, 0),
+                    (domain_id, name, task_type, 1, 0, 0, now_ns),
                 )
         return info
 
@@ -627,7 +633,7 @@ class SqliteTaskManager(I.TaskManager):
                 "UPDATE task_lists SET ack_level=?, kind=?, last_updated=? "
                 "WHERE domain_id=? AND name=? AND task_type=? AND range_id=?",
                 (
-                    info.ack_level, info.kind, info.last_updated,
+                    info.ack_level, info.kind, time.time_ns(),
                     info.domain_id, info.name, info.task_type, info.range_id,
                 ),
             )

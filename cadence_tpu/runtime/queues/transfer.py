@@ -200,6 +200,7 @@ class TransferQueueProcessor(QueueProcessorBase):
                 "parent_initiated_id": ei.initiated_id,
                 "memo": dict(ei.memo),
                 "search_attributes": dict(ei.search_attributes),
+                "branch_token": ei.branch_token,
                 "children": [
                     {
                         "policy": ci.parent_close_policy,
@@ -252,6 +253,14 @@ class TransferQueueProcessor(QueueProcessorBase):
         # (reference: processCloseExecution → parentclosepolicy)
         for child in snap["children"]:
             self._apply_parent_close_policy(child)
+        # archival fan-out (reference: processCloseExecution →
+        # archivalClient.Archive when the domain has archival enabled)
+        client = getattr(self, "archival_client", None)
+        if client is not None:
+            try:
+                client.maybe_archive(task, snap)
+            except Exception:
+                self._tlog.exception("archival trigger failed")
 
     def _apply_parent_close_policy(self, child: dict) -> None:
         policy = child["policy"]

@@ -1,0 +1,97 @@
+"""Batcher: bulk terminate/cancel/signal as a system workflow.
+
+Reference: service/worker/batcher/ — batcher.go + workflow.go: a batch
+request (operation + target query/list) runs as a workflow whose
+activity pages through matching executions applying the operation with
+a rate cap, heartbeating progress.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from cadence_tpu.runtime.api import SignalRequest
+
+from .sdk import Worker
+from .archiver import SYSTEM_DOMAIN
+
+BATCHER_WORKFLOW_TYPE = "cadence-sys-batch-workflow"
+BATCHER_TASK_LIST = "cadence-batcher-tl"
+
+
+def batch_workflow(ctx, input: bytes):
+    """input: json {operation, domain, query|executions, params}."""
+    summary = yield ctx.schedule_activity(
+        "run_batch", input, start_to_close_timeout_seconds=3600,
+    )
+    return summary
+
+
+class BatcherActivities:
+    def __init__(self, frontend) -> None:
+        self.frontend = frontend
+
+    def run_batch(self, payload: bytes) -> bytes:
+        req = json.loads(payload)
+        operation = req["operation"]
+        domain = req["domain"]
+        params = req.get("params", {})
+        targets = self._targets(req)
+        done = 0
+        errors: List[str] = []
+        for wf_id, run_id in targets:
+            try:
+                if operation == "terminate":
+                    self.frontend.terminate_workflow_execution(
+                        domain, wf_id, run_id,
+                        reason=params.get("reason", "batch terminate"),
+                    )
+                elif operation == "cancel":
+                    self.frontend.request_cancel_workflow_execution(
+                        domain, wf_id, run_id
+                    )
+                elif operation == "signal":
+                    self.frontend.signal_workflow_execution(
+                        SignalRequest(
+                            domain=domain, workflow_id=wf_id, run_id=run_id,
+                            signal_name=params.get("signal_name", ""),
+                            input=params.get(
+                                "signal_input", ""
+                            ).encode(),
+                        )
+                    )
+                else:
+                    raise ValueError(f"unknown operation {operation!r}")
+                done += 1
+            except Exception as e:
+                errors.append(f"{wf_id}: {e}")
+        return json.dumps(
+            {"done": done, "failed": len(errors), "errors": errors[:10]}
+        ).encode()
+
+    def _targets(self, req) -> List[tuple]:
+        if req.get("executions"):
+            return [
+                (e["workflow_id"], e.get("run_id", ""))
+                for e in req["executions"]
+            ]
+        query = req.get("query", "")
+        out = []
+        token = 0
+        while True:
+            recs, token = self.frontend.list_workflow_executions(
+                req["domain"], query, page_size=200, next_token=token
+            )
+            out.extend((r.workflow_id, r.run_id) for r in recs)
+            if not token:
+                return out
+
+
+def build_batcher_worker(frontend) -> Worker:
+    acts = BatcherActivities(frontend)
+    w = Worker(frontend, SYSTEM_DOMAIN, BATCHER_TASK_LIST,
+               identity="batcher")
+    w.register_workflow(BATCHER_WORKFLOW_TYPE, batch_workflow)
+    w.register_activity("run_batch", acts.run_batch)
+    return w

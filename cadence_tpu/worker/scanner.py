@@ -1,0 +1,151 @@
+"""Scanner: scavengers running as system workflows.
+
+Reference: service/worker/scanner/ — scanner.go:101-171 launches
+scavenger workflows on the system domain; tasklist/scavenger.go deletes
+expired/orphan task lists, history/scavenger.go deletes history
+branches whose workflow is gone. Scavenger passes run as activities;
+the workflow loops pass → sleep → continue-as-new (a cron shape).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from .sdk import Worker
+from .archiver import SYSTEM_DOMAIN
+
+SCANNER_WORKFLOW_TYPE = "cadence-sys-scanner-workflow"
+SCANNER_WORKFLOW_ID = "cadence-scanner"
+SCANNER_TASK_LIST = "cadence-scanner-tl"
+
+
+def scanner_workflow(ctx, input: bytes):
+    """One pass of every scavenger, then sleep and continue-as-new."""
+    summary = yield ctx.schedule_activity(
+        "scavenge_task_lists", b"", start_to_close_timeout_seconds=300,
+    )
+    summary2 = yield ctx.schedule_activity(
+        "scavenge_history", b"", start_to_close_timeout_seconds=300,
+    )
+    interval = int(input or b"60")
+    yield ctx.start_timer(interval)
+    yield ctx.continue_as_new(input)
+    return summary + b"|" + summary2
+
+
+class ScannerActivities:
+    def __init__(
+        self,
+        task_manager,
+        history_manager=None,
+        execution_manager=None,
+        num_shards: int = 0,
+        idle_task_list_age_s: float = 3600.0,
+        now=time.time,
+    ) -> None:
+        self.tasks = task_manager
+        self.history = history_manager
+        self.execution = execution_manager
+        self.num_shards = num_shards
+        self.idle_age = idle_task_list_age_s
+        self.now = now
+        # trees seen orphaned on the previous scavenge pass
+        self._orphan_candidates: set = set()
+
+    # -- tasklist scavenger (tasklist/scavenger.go) --------------------
+
+    def scavenge_task_lists(self, _input: bytes = b"") -> bytes:
+        """Delete task lists with an expired lease, no backlog and no
+        recent pollers."""
+        deleted = 0
+        scanned = 0
+        for info in self.tasks.list_task_lists():
+            scanned += 1
+            backlog = self.tasks.get_tasks(
+                info.domain_id, info.name, info.task_type,
+                0, 1 << 62, 1,
+            )
+            if backlog:
+                continue
+            if not info.last_updated:
+                continue  # age unknown: never delete on a guess
+            age = self.now() - info.last_updated / 1e9
+            if age < self.idle_age:
+                continue
+            try:
+                self.tasks.delete_task_list(
+                    info.domain_id, info.name, info.task_type,
+                    info.range_id,
+                )
+                deleted += 1
+            except Exception:
+                continue  # raced with a new lease: leave it
+        return json.dumps({"scanned": scanned, "deleted": deleted}).encode()
+
+    # -- history scavenger (history/scavenger.go) ----------------------
+
+    def scavenge_history(self, _input: bytes = b"") -> bytes:
+        """Delete history trees whose workflow execution is gone.
+
+        Two-phase: a tree is deleted only when it was ALSO orphaned on
+        the previous pass — closing the race with workflow creation,
+        where the branch is written before the execution record
+        (context.create_workflow). The reference uses an age threshold;
+        two sightings across the scan interval bounds the same risk."""
+        if self.history is None or self.execution is None:
+            return json.dumps({"skipped": True}).encode()
+        list_trees = getattr(self.history, "list_history_trees", None)
+        if list_trees is None:
+            return json.dumps({"skipped": True}).encode()
+        live = self._live_run_ids()
+        deleted = 0
+        scanned = 0
+        orphans = set()
+        for tree_id, branches in list_trees():
+            scanned += 1
+            if tree_id in live:
+                continue
+            orphans.add(tree_id)
+            if tree_id not in self._orphan_candidates:
+                continue  # first sighting: candidate only
+            for branch in branches:
+                try:
+                    self.history.delete_history_branch(branch)
+                    deleted += 1
+                except Exception:
+                    pass
+        self._orphan_candidates = orphans
+        return json.dumps({"scanned": scanned, "deleted": deleted}).encode()
+
+    def _live_run_ids(self) -> set:
+        """All concrete-execution run ids, one scan per pass.
+        list_concrete_executions yields (domain_id, workflow_id, run_id)
+        tuples (persistence/memory.py)."""
+        live = set()
+        for shard_id in range(self.num_shards):
+            try:
+                for _, _, rid in self.execution.list_concrete_executions(
+                    shard_id
+                ):
+                    live.add(rid)
+            except Exception:
+                continue
+        return live
+
+
+def build_scanner_worker(
+    frontend, task_manager, history_manager=None, execution_manager=None,
+    num_shards: int = 0, **kwargs,
+) -> Worker:
+    acts = ScannerActivities(
+        task_manager, history_manager, execution_manager,
+        num_shards=num_shards, **kwargs,
+    )
+    w = Worker(frontend, SYSTEM_DOMAIN, SCANNER_TASK_LIST,
+               identity="scanner")
+    w.register_workflow(SCANNER_WORKFLOW_TYPE, scanner_workflow)
+    w.register_activity("scavenge_task_lists", acts.scavenge_task_lists)
+    w.register_activity("scavenge_history", acts.scavenge_history)
+    return w

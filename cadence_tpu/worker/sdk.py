@@ -1,0 +1,681 @@
+"""Deterministic replay-based worker SDK.
+
+The reference's worker daemons run as Cadence workflows via the Go
+client SDK (uber-go/cadence); this is the equivalent for this framework:
+workflow code is a Python GENERATOR that yields commands; on every
+decision task the runner replays the full history through the generator
+— commands whose outcome is already recorded feed results back in,
+the first unresolved command batch becomes this decision's output.
+Determinism contract: workflow code must derive everything from
+``ctx``/inputs (no wall clock, no I/O) — identical to the reference
+SDK's replay rules.
+
+Workflow code shape::
+
+    def greet(ctx, input):
+        name = yield ctx.schedule_activity("fetch-name", input)
+        yield ctx.start_timer(5)
+        sig = yield ctx.wait_signal("go")
+        return b"hello " + name
+
+Activities are plain functions registered on the ActivityWorker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import traceback
+from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
+
+from cadence_tpu.core.enums import DecisionType, EventType
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.runtime.api import Decision
+
+
+class ActivityError(Exception):
+    """Raised into workflow code when an activity failed/timed out."""
+
+    def __init__(self, reason: str, details: bytes = b"") -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.details = details
+
+
+class _NonDeterminismError(Exception):
+    pass
+
+
+# -- commands yielded by workflow code ------------------------------------
+
+
+@dataclasses.dataclass
+class _ActivityCmd:
+    activity_type: str
+    input: bytes
+    task_list: str
+    start_to_close: int
+    schedule_to_start: int
+    heartbeat: int
+    retry_policy: Optional[dict]
+    activity_id: str = ""  # assigned by the runner
+
+
+@dataclasses.dataclass
+class _TimerCmd:
+    seconds: int
+    timer_id: str = ""
+
+
+@dataclasses.dataclass
+class _SignalWaitCmd:
+    name: str
+
+
+@dataclasses.dataclass
+class _SignalPollCmd:
+    """Non-blocking: next unconsumed signal or None."""
+
+    name: str
+
+
+@dataclasses.dataclass
+class _ChildCmd:
+    workflow_type: str
+    workflow_id: str
+    input: bytes
+    task_list: str
+    execution_timeout: int
+    task_timeout: int
+    parent_close_policy: int
+
+
+@dataclasses.dataclass
+class _ContinueAsNewCmd:
+    input: bytes
+    workflow_type: str = ""
+    task_list: str = ""
+    execution_timeout: int = 0
+    task_timeout: int = 0
+
+
+@dataclasses.dataclass
+class _SignalExternalCmd:
+    domain: str
+    workflow_id: str
+    run_id: str
+    signal_name: str
+    input: bytes
+
+
+class WorkflowContext:
+    """Command factory handed to workflow code."""
+
+    def schedule_activity(
+        self, activity_type: str, input: bytes = b"",
+        task_list: str = "", start_to_close_timeout_seconds: int = 60,
+        schedule_to_start_timeout_seconds: int = 60,
+        heartbeat_timeout_seconds: int = 0,
+        retry_policy: Optional[dict] = None,
+    ) -> _ActivityCmd:
+        return _ActivityCmd(
+            activity_type, input, task_list,
+            start_to_close_timeout_seconds,
+            schedule_to_start_timeout_seconds,
+            heartbeat_timeout_seconds, retry_policy,
+        )
+
+    def start_timer(self, seconds: int) -> _TimerCmd:
+        return _TimerCmd(seconds)
+
+    def wait_signal(self, name: str) -> _SignalWaitCmd:
+        return _SignalWaitCmd(name)
+
+    def poll_signal(self, name: str) -> _SignalPollCmd:
+        """Non-blocking signal read: yields the next unconsumed payload
+        or None when the recorded history has no more — used to drain
+        pending signals before continue-as-new (reference pump.go)."""
+        return _SignalPollCmd(name)
+
+    def start_child_workflow(
+        self, workflow_type: str, workflow_id: str, input: bytes = b"",
+        task_list: str = "", execution_timeout: int = 60,
+        task_timeout: int = 10, parent_close_policy: int = 2,
+    ) -> _ChildCmd:
+        return _ChildCmd(
+            workflow_type, workflow_id, input, task_list,
+            execution_timeout, task_timeout, parent_close_policy,
+        )
+
+    def continue_as_new(self, input: bytes = b"", **kw) -> _ContinueAsNewCmd:
+        return _ContinueAsNewCmd(input, **kw)
+
+    def signal_external(
+        self, domain: str, workflow_id: str, signal_name: str,
+        input: bytes = b"", run_id: str = "",
+    ) -> _SignalExternalCmd:
+        return _SignalExternalCmd(
+            domain, workflow_id, run_id, signal_name, input
+        )
+
+
+# -- history → replay state -----------------------------------------------
+
+
+class _ReplayState:
+    def __init__(self, history: List[HistoryEvent]) -> None:
+        self.input: bytes = b""
+        self.workflow_type = ""
+        self.task_list = ""
+        # activity_id → ("completed", result) | ("failed", reason, details)
+        self.activity_outcome: Dict[str, Tuple] = {}
+        self.activities_scheduled: set = set()
+        # timer_id → fired?
+        self.timers_started: set = set()
+        self.timers_fired: set = set()
+        # child workflow_id → outcome
+        self.children_started: set = set()
+        self.child_outcome: Dict[str, Tuple] = {}
+        # signals by name (FIFO)
+        self.signals: Dict[str, List[bytes]] = {}
+        # history-ordered initiation lists: replay matches the Nth yield
+        # of a command type to the Nth initiation event, so repeating the
+        # same target is not deduped away
+        self.signals_external_list: List[tuple] = []
+        self.children_list: List[str] = []
+
+        sched_to_aid: Dict[int, str] = {}
+        init_to_child: Dict[int, str] = {}
+        for e in history:
+            a = e.attributes
+            et = e.event_type
+            if et == EventType.WorkflowExecutionStarted:
+                self.input = a.get("input", b"") or b""
+                self.workflow_type = a.get("workflow_type", "")
+                self.task_list = a.get("task_list", "")
+            elif et == EventType.ActivityTaskScheduled:
+                aid = a.get("activity_id", "")
+                self.activities_scheduled.add(aid)
+                sched_to_aid[e.event_id] = aid
+            elif et == EventType.ActivityTaskCompleted:
+                aid = sched_to_aid.get(a.get("scheduled_event_id"))
+                if aid:
+                    self.activity_outcome[aid] = (
+                        "completed", a.get("result", b"")
+                    )
+            elif et == EventType.ActivityTaskFailed:
+                aid = sched_to_aid.get(a.get("scheduled_event_id"))
+                if aid:
+                    self.activity_outcome[aid] = (
+                        "failed", a.get("reason", ""), a.get("details", b"")
+                    )
+            elif et == EventType.ActivityTaskTimedOut:
+                aid = sched_to_aid.get(a.get("scheduled_event_id"))
+                if aid:
+                    self.activity_outcome[aid] = ("failed", "timeout", b"")
+            elif et == EventType.ActivityTaskCanceled:
+                aid = sched_to_aid.get(a.get("scheduled_event_id"))
+                if aid:
+                    self.activity_outcome[aid] = ("failed", "canceled", b"")
+            elif et == EventType.TimerStarted:
+                self.timers_started.add(a.get("timer_id", ""))
+            elif et == EventType.TimerFired:
+                self.timers_fired.add(a.get("timer_id", ""))
+            elif et == EventType.WorkflowExecutionSignaled:
+                self.signals.setdefault(
+                    a.get("signal_name", ""), []
+                ).append(a.get("input", b"") or b"")
+            elif et == EventType.StartChildWorkflowExecutionInitiated:
+                wid = a.get("workflow_id", "")
+                self.children_started.add(wid)
+                self.children_list.append(wid)
+                init_to_child[e.event_id] = wid
+            elif et == EventType.ChildWorkflowExecutionCompleted:
+                wid = init_to_child.get(a.get("initiated_event_id"))
+                if wid:
+                    self.child_outcome[wid] = (
+                        "completed", a.get("result", b"")
+                    )
+            elif et in (
+                EventType.ChildWorkflowExecutionFailed,
+                EventType.ChildWorkflowExecutionTimedOut,
+                EventType.ChildWorkflowExecutionCanceled,
+                EventType.ChildWorkflowExecutionTerminated,
+                EventType.StartChildWorkflowExecutionFailed,
+            ):
+                wid = init_to_child.get(
+                    a.get("initiated_event_id")
+                ) or a.get("workflow_id", "")
+                if wid:
+                    self.child_outcome[wid] = (
+                        "failed", a.get("reason", str(et)), b""
+                    )
+            elif et == EventType.SignalExternalWorkflowExecutionInitiated:
+                self.signals_external_list.append(
+                    (a.get("workflow_id", ""), a.get("signal_name", ""))
+                )
+
+
+# -- the replay runner ----------------------------------------------------
+
+
+class _Driver:
+    def __init__(
+        self, fn: Callable, state: _ReplayState,
+    ) -> None:
+        self.fn = fn
+        self.state = state
+        self.decisions: List[Decision] = []
+        self.seq = {"a": 0, "t": 0, "c": 0, "s": 0}
+        self.signal_cursor: Dict[str, int] = {}
+        self.closed = False
+
+    def _next_id(self, kind: str) -> str:
+        self.seq[kind] += 1
+        return f"{kind}{self.seq[kind]}"
+
+    def run(self) -> List[Decision]:
+        ctx = WorkflowContext()
+        gen = self.fn(ctx, self.state.input)
+        if not isinstance(gen, Generator):
+            # plain function: complete immediately with its return value
+            self.decisions.append(
+                Decision(
+                    DecisionType.CompleteWorkflowExecution,
+                    {"result": gen if isinstance(gen, bytes) else b""},
+                )
+            )
+            return self.decisions
+        try:
+            to_send: Any = None
+            to_throw: Optional[BaseException] = None
+            while True:
+                cmd = (
+                    gen.throw(to_throw) if to_throw is not None
+                    else gen.send(to_send)
+                )
+                to_send, to_throw, blocked = self._handle(cmd)
+                if blocked:
+                    return self.decisions
+        except StopIteration as done:
+            result = done.value if isinstance(done.value, bytes) else b""
+            if not self.closed:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.CompleteWorkflowExecution,
+                        {"result": result},
+                    )
+                )
+            return self.decisions
+        except _NonDeterminismError:
+            raise
+        except Exception:
+            if not self.closed:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.FailWorkflowExecution,
+                        {
+                            "reason": "workflow code raised",
+                            "details": traceback.format_exc().encode(),
+                        },
+                    )
+                )
+            return self.decisions
+
+    def _handle(self, cmd) -> Tuple[Any, Optional[BaseException], bool]:
+        """Returns (value_to_send, exc_to_throw, blocked)."""
+        st = self.state
+        if isinstance(cmd, _ActivityCmd):
+            aid = cmd.activity_id or self._next_id("a")
+            outcome = st.activity_outcome.get(aid)
+            if outcome is not None:
+                if outcome[0] == "completed":
+                    return outcome[1], None, False
+                return None, ActivityError(outcome[1], outcome[2]), False
+            if aid not in st.activities_scheduled:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.ScheduleActivityTask,
+                        {
+                            "activity_id": aid,
+                            "activity_type": cmd.activity_type,
+                            "task_list": cmd.task_list or st.task_list,
+                            "input": cmd.input,
+                            "schedule_to_start_timeout_seconds": cmd.schedule_to_start,
+                            "start_to_close_timeout_seconds": cmd.start_to_close,
+                            "heartbeat_timeout_seconds": cmd.heartbeat,
+                            "retry_policy": cmd.retry_policy,
+                        },
+                    )
+                )
+            return None, None, True  # awaiting the outcome
+        if isinstance(cmd, _TimerCmd):
+            tid = cmd.timer_id or self._next_id("t")
+            if tid in st.timers_fired:
+                return None, None, False
+            if tid not in st.timers_started:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.StartTimer,
+                        {
+                            "timer_id": tid,
+                            "start_to_fire_timeout_seconds": cmd.seconds,
+                        },
+                    )
+                )
+            return None, None, True
+        if isinstance(cmd, _SignalWaitCmd):
+            cursor = self.signal_cursor.get(cmd.name, 0)
+            queue = st.signals.get(cmd.name, [])
+            if cursor < len(queue):
+                self.signal_cursor[cmd.name] = cursor + 1
+                return queue[cursor], None, False
+            return None, None, True  # wait for the signal
+        if isinstance(cmd, _SignalPollCmd):
+            cursor = self.signal_cursor.get(cmd.name, 0)
+            queue = st.signals.get(cmd.name, [])
+            if cursor < len(queue):
+                self.signal_cursor[cmd.name] = cursor + 1
+                return queue[cursor], None, False
+            return None, None, False  # nothing recorded: None, no block
+        if isinstance(cmd, _ChildCmd):
+            wid = cmd.workflow_id
+            child_idx = self.seq["c"]
+            self.seq["c"] += 1
+            outcome = st.child_outcome.get(wid)
+            if outcome is not None:
+                if outcome[0] == "completed":
+                    return outcome[1], None, False
+                return None, ActivityError(outcome[1]), False
+            if child_idx >= len(st.children_list):
+                self.decisions.append(
+                    Decision(
+                        DecisionType.StartChildWorkflowExecution,
+                        {
+                            "workflow_id": wid,
+                            "workflow_type": cmd.workflow_type,
+                            "task_list": cmd.task_list or st.task_list,
+                            "input": cmd.input,
+                            "execution_start_to_close_timeout_seconds": (
+                                cmd.execution_timeout
+                            ),
+                            "task_start_to_close_timeout_seconds": (
+                                cmd.task_timeout
+                            ),
+                            "parent_close_policy": cmd.parent_close_policy,
+                        },
+                    )
+                )
+            return None, None, True
+        if isinstance(cmd, _SignalExternalCmd):
+            sig_idx = self.seq["s"]
+            self.seq["s"] += 1
+            if sig_idx >= len(st.signals_external_list):
+                self.decisions.append(
+                    Decision(
+                        DecisionType.SignalExternalWorkflowExecution,
+                        {
+                            "domain": cmd.domain,
+                            "workflow_id": cmd.workflow_id,
+                            "run_id": cmd.run_id,
+                            "signal_name": cmd.signal_name,
+                            "input": cmd.input,
+                        },
+                    )
+                )
+            return None, None, False  # fire and forget
+        if isinstance(cmd, _ContinueAsNewCmd):
+            self.decisions.append(
+                Decision(
+                    DecisionType.ContinueAsNewWorkflowExecution,
+                    {
+                        "workflow_type": cmd.workflow_type or st.workflow_type,
+                        "task_list": cmd.task_list or st.task_list,
+                        "input": cmd.input,
+                        "execution_start_to_close_timeout_seconds": (
+                            cmd.execution_timeout or 60
+                        ),
+                        "task_start_to_close_timeout_seconds": (
+                            cmd.task_timeout or 10
+                        ),
+                    },
+                )
+            )
+            self.closed = True
+            raise StopIteration(b"")
+        raise _NonDeterminismError(f"unknown command {cmd!r}")
+
+
+# -- registries + workers -------------------------------------------------
+
+
+class WorkflowRegistry:
+    def __init__(self) -> None:
+        self._workflows: Dict[str, Callable] = {}
+        self._query_handlers: Dict[str, Callable] = {}
+
+    def register_workflow(self, workflow_type: str, fn: Callable) -> None:
+        self._workflows[workflow_type] = fn
+
+    def register_query_handler(
+        self, workflow_type: str, fn: Callable[[str, bytes], bytes]
+    ) -> None:
+        self._query_handlers[workflow_type] = fn
+
+    def workflow(self, workflow_type: str) -> Callable:
+        fn = self._workflows.get(workflow_type)
+        if fn is None:
+            raise KeyError(f"workflow type {workflow_type!r} not registered")
+        return fn
+
+    def query_handler(self, workflow_type: str):
+        return self._query_handlers.get(workflow_type)
+
+
+def replay_decide(
+    registry: WorkflowRegistry, history: List[HistoryEvent]
+) -> List[Decision]:
+    """Pure function: full history → this decision's output."""
+    state = _ReplayState(history)
+    fn = registry.workflow(state.workflow_type)
+    return _Driver(fn, state).run()
+
+
+class DecisionWorker:
+    def __init__(
+        self, frontend, domain: str, task_list: str,
+        registry: WorkflowRegistry, identity: str = "decision-worker",
+    ) -> None:
+        self.frontend = frontend
+        self.domain = domain
+        self.task_list = task_list
+        self.registry = registry
+        self.identity = identity
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_and_process_one(self, timeout_s: float = 1.0) -> bool:
+        task = self.frontend.poll_for_decision_task(
+            self.domain, self.task_list,
+            identity=self.identity, timeout_s=timeout_s,
+        )
+        if task is None:
+            return False
+        if task.query is not None:
+            self._answer_direct_query(task)
+            return True
+        state = _ReplayState(task.history)
+        try:
+            decisions = replay_decide(self.registry, task.history)
+        except Exception:
+            self.frontend.respond_decision_task_failed(
+                task.task_token, identity=self.identity,
+                details=traceback.format_exc().encode(),
+            )
+            return True
+        query_results = {}
+        for qid, q in (task.queries or {}).items():
+            query_results[qid] = self._run_query_handler(
+                state, q.get("query_type", ""), q.get("query_args", b"")
+            )
+        self.frontend.respond_decision_task_completed(
+            task.task_token, decisions, identity=self.identity,
+            query_results=query_results or None,
+        )
+        return True
+
+    def _run_query_handler(self, state, query_type: str, args: bytes):
+        handler = self.registry.query_handler(state.workflow_type)
+        if handler is None:
+            return {"error": f"no query handler for {state.workflow_type}"}
+        try:
+            return {"result": handler(query_type, args)}
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _answer_direct_query(self, task) -> None:
+        q = task.query
+        # direct queries carry no history; look the workflow up
+        try:
+            events, _ = self.frontend.get_workflow_execution_history(
+                self.domain, task.workflow_id, task.run_id
+            )
+            state = _ReplayState(events)
+            out = self._run_query_handler(
+                state, q.get("query_type", ""), q.get("query_args", b"")
+            )
+        except Exception as e:
+            out = {"error": str(e)}
+        self.frontend.respond_query_task_completed(
+            self.task_list, q["query_id"],
+            result=out.get("result", b"") or b"",
+            error=out.get("error", "") or "",
+        )
+
+    def run_until_stopped(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_and_process_one(timeout_s=0.2)
+            except Exception:
+                self._stop.wait(0.1)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run_until_stopped,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def activity_method(fn: Callable) -> Callable:
+    """Marker decorator for activity implementations."""
+    fn.__is_activity__ = True
+    return fn
+
+
+class ActivityWorker:
+    def __init__(
+        self, frontend, domain: str, task_list: str,
+        identity: str = "activity-worker",
+    ) -> None:
+        self.frontend = frontend
+        self.domain = domain
+        self.task_list = task_list
+        self.identity = identity
+        self._activities: Dict[str, Callable] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register_activity(self, activity_type: str, fn: Callable) -> None:
+        self._activities[activity_type] = fn
+
+    def register_activities_from(self, obj: Any) -> None:
+        for name in dir(obj):
+            fn = getattr(obj, name)
+            if callable(fn) and getattr(fn, "__is_activity__", False):
+                self._activities[name] = fn
+
+    def poll_and_process_one(self, timeout_s: float = 1.0) -> bool:
+        task = self.frontend.poll_for_activity_task(
+            self.domain, self.task_list,
+            identity=self.identity, timeout_s=timeout_s,
+        )
+        if task is None:
+            return False
+        fn = self._activities.get(task.activity_type)
+        if fn is None:
+            self.frontend.respond_activity_task_failed(
+                task.task_token,
+                reason=f"activity {task.activity_type!r} not registered",
+                identity=self.identity,
+            )
+            return True
+        try:
+            result = fn(task.input)
+        except Exception as e:
+            self.frontend.respond_activity_task_failed(
+                task.task_token, reason=str(e) or type(e).__name__,
+                details=traceback.format_exc().encode(),
+                identity=self.identity,
+            )
+            return True
+        self.frontend.respond_activity_task_completed(
+            task.task_token,
+            result=result if isinstance(result, bytes) else b"",
+            identity=self.identity,
+        )
+        return True
+
+    def run_until_stopped(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_and_process_one(timeout_s=0.2)
+            except Exception:
+                self._stop.wait(0.1)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run_until_stopped,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+class Worker:
+    """Decision + activity workers on one (domain, task list)."""
+
+    def __init__(
+        self, frontend, domain: str, task_list: str,
+        identity: str = "worker",
+    ) -> None:
+        self.registry = WorkflowRegistry()
+        self.decisions = DecisionWorker(
+            frontend, domain, task_list, self.registry,
+            identity=f"{identity}-decider",
+        )
+        self.activities = ActivityWorker(
+            frontend, domain, task_list, identity=f"{identity}-activities"
+        )
+
+    def register_workflow(self, workflow_type: str, fn: Callable) -> None:
+        self.registry.register_workflow(workflow_type, fn)
+
+    def register_activity(self, activity_type: str, fn: Callable) -> None:
+        self.activities.register_activity(activity_type, fn)
+
+    def register_query_handler(self, workflow_type: str, fn) -> None:
+        self.registry.register_query_handler(workflow_type, fn)
+
+    def start(self) -> None:
+        self.decisions.start()
+        self.activities.start()
+
+    def stop(self) -> None:
+        self.decisions.stop()
+        self.activities.stop()

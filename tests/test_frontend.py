@@ -273,7 +273,17 @@ class TestVisibility:
             task.task_token,
             [Decision(DecisionType.CompleteWorkflowExecution, {})],
         )
-        assert fb.history.drain_queues()
+        # wait for the close-visibility record (queue drain has a small
+        # notify window; poll the observable state instead)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            closed, _ = fb.frontend.list_closed_workflow_executions(
+                "fe-domain"
+            )
+            if closed:
+                return
+            time.sleep(0.05)
+        raise AssertionError("close visibility record never appeared")
 
     def test_list_open_closed(self, fb):
         self._seed(fb)

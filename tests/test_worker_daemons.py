@@ -1,0 +1,266 @@
+"""Worker daemons + archival end-to-end tests.
+
+Reference strategies: host/archival_test.go (close → archived history
+readable), scanner/batcher unit flows, indexer Kafka→ES pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from cadence_tpu.archival import ArchiverProvider, URI
+from cadence_tpu.core.enums import DecisionType, EventType
+from cadence_tpu.frontend.domain_handler import ArchivalStatus
+from cadence_tpu.messaging import MessageBus
+from cadence_tpu.runtime.api import Decision, StartWorkflowRequest
+from cadence_tpu.runtime.persistence.records import VisibilityRecord
+from cadence_tpu.worker.archiver import (
+    ARCHIVAL_TASK_LIST,
+    ArchivalClient,
+    build_archiver_worker,
+)
+from cadence_tpu.worker.batcher import (
+    BATCHER_TASK_LIST,
+    BATCHER_WORKFLOW_TYPE,
+    build_batcher_worker,
+)
+from cadence_tpu.worker.indexer import BusVisibilityClient, Indexer
+from cadence_tpu.worker.scanner import ScannerActivities
+from cadence_tpu.worker.service import SYSTEM_DOMAIN, WorkerService
+from tests.test_frontend import FrontendBox
+
+DOMAIN = "wk-domain"
+
+
+@pytest.fixture()
+def box():
+    b = FrontendBox()
+    b.domain_handler.register_domain(DOMAIN)
+    yield b
+    b.stop()
+
+
+def _start(box, wf_id, task_list="wk-tl", domain=DOMAIN):
+    return box.frontend.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=domain, workflow_id=wf_id, workflow_type="t",
+            task_list=task_list,
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+
+
+def _complete(box, task_list="wk-tl", result=b"done"):
+    task = box.frontend.poll_for_decision_task(
+        DOMAIN, task_list, timeout_s=5.0
+    )
+    assert task is not None
+    box.frontend.respond_decision_task_completed(
+        task.task_token,
+        [Decision(DecisionType.CompleteWorkflowExecution, {"result": result})],
+    )
+    return task
+
+
+class TestArchiver:
+    def test_close_triggers_archival_workflow(self, box, tmp_path):
+        # archival-enabled domain
+        box.domain_handler.register_domain(
+            "arch-dom",
+            history_archival_status=ArchivalStatus.ENABLED,
+            history_archival_uri=f"file://{tmp_path}/arch",
+            visibility_archival_status=ArchivalStatus.ENABLED,
+            visibility_archival_uri=f"file://{tmp_path}/arch-vis",
+        )
+        # wire the archival client into every shard's transfer processor
+        box.frontend.register_domain(SYSTEM_DOMAIN, retention_days=1)
+        client = ArchivalClient(box.frontend, box.domains)
+        for shard_id in box.history.controller.owned_shards():
+            handle = box.history.controller._handles[shard_id]
+            for p in handle.processors:
+                if hasattr(p, "_process_close"):
+                    p.archival_client = client
+        worker = build_archiver_worker(
+            box.frontend, box.persistence.history,
+            box.persistence.execution,
+            shard_resolver=box.history.controller.shard_for,
+        )
+        worker.start()
+        try:
+            run_id = _start(box, "arch-wf", domain="arch-dom")
+            task = box.frontend.poll_for_decision_task(
+                "arch-dom", "wk-tl", timeout_s=5.0
+            )
+            box.frontend.respond_decision_task_completed(
+                task.task_token,
+                [Decision(DecisionType.CompleteWorkflowExecution,
+                          {"result": b"bye"})],
+            )
+            # close processor → signal archival workflow → activities
+            provider = ArchiverProvider.default()
+            archiver = provider.get_history_archiver("file")
+            uri = URI.parse(f"file://{tmp_path}/arch")
+            domain_id = box.domains.get_by_name("arch-dom").info.id
+            deadline = time.monotonic() + 10.0
+            batches = None
+            while time.monotonic() < deadline:
+                try:
+                    batches, _ = archiver.get(
+                        uri, domain_id, "arch-wf", run_id
+                    )
+                    break
+                except FileNotFoundError:
+                    time.sleep(0.1)
+            assert batches, "history never archived"
+            events = [e for b in batches for e in b]
+            assert events[0].event_type == EventType.WorkflowExecutionStarted
+            assert events[-1].event_type == EventType.WorkflowExecutionCompleted
+
+            vis_archiver = provider.get_visibility_archiver("file")
+            vis_uri = URI.parse(f"file://{tmp_path}/arch-vis")
+            deadline = time.monotonic() + 5.0
+            recs = []
+            while time.monotonic() < deadline:
+                recs, _ = vis_archiver.query(
+                    vis_uri, domain_id, "CloseStatus = 'COMPLETED'"
+                )
+                if recs:
+                    break
+                time.sleep(0.1)
+            assert recs and recs[0].workflow_id == "arch-wf"
+        finally:
+            worker.stop()
+
+
+class TestScanner:
+    def test_tasklist_scavenger(self, box):
+        # make an idle, empty task list with an old last_updated
+        info = box.persistence.task.lease_task_list("d1", "stale-tl", 0)
+        info.last_updated = 1  # epoch
+        box.persistence.task.update_task_list(info)
+        acts = ScannerActivities(
+            box.persistence.task, idle_task_list_age_s=0.0
+        )
+        out = json.loads(acts.scavenge_task_lists())
+        assert out["deleted"] >= 1
+        names = [t.name for t in box.persistence.task.list_task_lists()]
+        assert "stale-tl" not in names
+
+    def test_history_scavenger_removes_orphans(self, box):
+        h = box.persistence.history
+        branch = h.new_history_branch(tree_id="orphan-run")
+        from cadence_tpu.core import history_factory as F
+
+        h.append_history_nodes(
+            branch,
+            [F.workflow_execution_started(1, 0, 0, task_list="x",
+                                          workflow_type="t")],
+            transaction_id=1,
+        )
+        acts = ScannerActivities(
+            box.persistence.task, h, box.persistence.execution,
+            num_shards=2,
+        )
+        # two-phase: first pass marks the candidate, second deletes
+        first = json.loads(acts.scavenge_history())
+        assert first["deleted"] == 0
+        out = json.loads(acts.scavenge_history())
+        assert out["deleted"] >= 1
+
+    def test_history_scavenger_keeps_live_runs(self, box):
+        run_id = _start(box, "live-wf")
+        acts = ScannerActivities(
+            box.persistence.task, box.persistence.history,
+            box.persistence.execution, num_shards=2,
+        )
+        json.loads(acts.scavenge_history())
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "live-wf", run_id
+        )
+        assert events  # history intact
+
+
+class TestBatcher:
+    def test_batch_terminate_via_workflow(self, box):
+        for i in range(3):
+            _start(box, f"b-{i}")
+        assert box.history.drain_queues()
+        box.frontend.register_domain(SYSTEM_DOMAIN, retention_days=1)
+        worker = build_batcher_worker(box.frontend)
+        worker.start()
+        try:
+            payload = json.dumps({
+                "operation": "terminate",
+                "domain": DOMAIN,
+                "query": "CloseTime = 0",
+                "params": {"reason": "test sweep"},
+            }).encode()
+            box.frontend.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain=SYSTEM_DOMAIN, workflow_id="batch-1",
+                    workflow_type=BATCHER_WORKFLOW_TYPE,
+                    task_list=BATCHER_TASK_LIST, input=payload,
+                    execution_start_to_close_timeout_seconds=300,
+                )
+            )
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                desc = box.frontend.describe_workflow_execution(
+                    SYSTEM_DOMAIN, "batch-1"
+                )
+                if not desc.is_running:
+                    break
+                time.sleep(0.05)
+            for i in range(3):
+                desc = box.frontend.describe_workflow_execution(
+                    DOMAIN, f"b-{i}"
+                )
+                assert not desc.is_running, f"b-{i} still running"
+        finally:
+            worker.stop()
+
+
+class TestIndexer:
+    def test_bus_visibility_pipeline(self):
+        from cadence_tpu.runtime.persistence.memory import (
+            create_memory_bundle,
+        )
+        from cadence_tpu.visibility import AdvancedVisibilityStore
+
+        bus = MessageBus()
+        store = AdvancedVisibilityStore(create_memory_bundle().visibility)
+        producer = BusVisibilityClient(bus)
+        indexer = Indexer(bus, store)
+        rec = VisibilityRecord(
+            domain_id="d", workflow_id="w", run_id="r",
+            workflow_type="t", start_time=5,
+        )
+        producer.record_workflow_execution_started(rec)
+        rec2 = VisibilityRecord(
+            domain_id="d", workflow_id="w", run_id="r",
+            workflow_type="t", start_time=5, close_time=9, close_status=1,
+        )
+        producer.record_workflow_execution_closed(rec2)
+        assert indexer.process_backlog() == 2
+        recs, _ = store.list_workflow_executions(
+            "d", "CloseStatus = 'COMPLETED'"
+        )
+        assert len(recs) == 1 and recs[0].workflow_id == "w"
+
+
+class TestWorkerService:
+    def test_assembles_and_runs(self, box):
+        svc = WorkerService(
+            box.frontend, box.persistence, num_shards=2,
+            bus=box.bus, domain_handler=box.domain_handler,
+            history_service=box.history,
+        )
+        svc.start()
+        try:
+            assert len(svc.workers) == 4  # archiver scanner batcher pcp
+            assert box.frontend.describe_domain(name=SYSTEM_DOMAIN)
+        finally:
+            svc.stop()

@@ -1,0 +1,229 @@
+"""Worker SDK tests: deterministic generator workflows driven through
+the full stack (frontend → matching → history), the reference's
+taskpoller pattern as a real SDK.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.runtime.api import StartWorkflowRequest
+from cadence_tpu.worker import Worker
+from cadence_tpu.worker.sdk import ActivityError
+from tests.test_frontend import FrontendBox
+
+DOMAIN = "sdk-domain"
+TL = "sdk-tl"
+
+
+@pytest.fixture()
+def box():
+    b = FrontendBox()
+    b.domain_handler.register_domain(DOMAIN)
+    yield b
+    b.stop()
+
+
+def _worker(box):
+    return Worker(box.frontend, DOMAIN, TL)
+
+
+def _start(box, wf_id, wf_type, input=b"", timeout=60):
+    return box.frontend.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=DOMAIN, workflow_id=wf_id, workflow_type=wf_type,
+            task_list=TL, input=input,
+            execution_start_to_close_timeout_seconds=timeout,
+        )
+    )
+
+
+def _wait_closed(box, wf_id, run_id, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        desc = box.frontend.describe_workflow_execution(DOMAIN, wf_id, run_id)
+        if not desc.is_running:
+            return desc
+        time.sleep(0.05)
+    raise AssertionError(f"workflow {wf_id} still running")
+
+
+def test_activity_workflow_end_to_end(box):
+    def greet(ctx, input):
+        name = yield ctx.schedule_activity("fetch-name", input)
+        return b"hello " + name
+
+    w = _worker(box)
+    w.register_workflow("greet", greet)
+    w.register_activity("fetch-name", lambda inp: b"tpu-" + inp)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-wf1", "greet", input=b"x")
+        _wait_closed(box, "sdk-wf1", run_id)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf1", run_id
+        )
+        last = events[-1]
+        assert last.event_type == EventType.WorkflowExecutionCompleted
+        assert last.attributes["result"] == b"hello tpu-x"
+    finally:
+        w.stop()
+
+
+def test_activity_failure_propagates(box):
+    def flaky(ctx, input):
+        try:
+            yield ctx.schedule_activity("boom", b"")
+        except ActivityError as e:
+            return b"caught:" + e.reason.encode()
+
+    def boom(inp):
+        raise RuntimeError("exploded")
+
+    w = _worker(box)
+    w.register_workflow("flaky", flaky)
+    w.register_activity("boom", boom)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-wf2", "flaky")
+        _wait_closed(box, "sdk-wf2", run_id)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf2", run_id
+        )
+        assert events[-1].attributes["result"] == b"caught:exploded"
+    finally:
+        w.stop()
+
+
+def test_timer_workflow(box):
+    def napper(ctx, input):
+        yield ctx.start_timer(1)
+        return b"rested"
+
+    w = _worker(box)
+    w.register_workflow("napper", napper)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-wf3", "napper")
+        desc = _wait_closed(box, "sdk-wf3", run_id, timeout_s=15.0)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf3", run_id
+        )
+        types = [e.event_type for e in events]
+        assert EventType.TimerStarted in types
+        assert EventType.TimerFired in types
+        assert events[-1].attributes["result"] == b"rested"
+    finally:
+        w.stop()
+
+
+def test_signal_workflow(box):
+    def waiter(ctx, input):
+        payload = yield ctx.wait_signal("go")
+        return b"got:" + payload
+
+    w = _worker(box)
+    w.register_workflow("waiter", waiter)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-wf4", "waiter")
+        time.sleep(0.2)
+        from cadence_tpu.runtime.api import SignalRequest
+
+        box.frontend.signal_workflow_execution(
+            SignalRequest(
+                domain=DOMAIN, workflow_id="sdk-wf4",
+                signal_name="go", input=b"sig-data",
+            )
+        )
+        _wait_closed(box, "sdk-wf4", run_id)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf4", run_id
+        )
+        assert events[-1].attributes["result"] == b"got:sig-data"
+    finally:
+        w.stop()
+
+
+def test_child_workflow(box):
+    def parent(ctx, input):
+        out = yield ctx.start_child_workflow(
+            "child", "sdk-wf5-child", input=b"c-in"
+        )
+        return b"parent<" + out + b">"
+
+    def child(ctx, input):
+        r = yield ctx.schedule_activity("double", input)
+        return r
+
+    w = _worker(box)
+    w.register_workflow("parent", parent)
+    w.register_workflow("child", child)
+    w.register_activity("double", lambda inp: inp + inp)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-wf5", "parent")
+        _wait_closed(box, "sdk-wf5", run_id, timeout_s=15.0)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf5", run_id
+        )
+        assert events[-1].attributes["result"] == b"parent<c-inc-in>"
+    finally:
+        w.stop()
+
+
+def test_continue_as_new(box):
+    def chain(ctx, input):
+        n = int(input or b"0")
+        if n < 2:
+            yield ctx.continue_as_new(str(n + 1).encode())
+        return b"gen-" + input
+
+    w = _worker(box)
+    w.register_workflow("chain", chain)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-wf6", "chain", input=b"0")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            desc = box.frontend.describe_workflow_execution(
+                DOMAIN, "sdk-wf6"
+            )  # current run
+            if not desc.is_running:
+                break
+            time.sleep(0.05)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf6"
+        )
+        assert events[-1].attributes["result"] == b"gen-2"
+        # first run closed as continued-as-new
+        first, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-wf6", run_id
+        )
+        assert first[-1].event_type == EventType.WorkflowExecutionContinuedAsNew
+    finally:
+        w.stop()
+
+
+def test_query_handler_through_worker(box):
+    def steady(ctx, input):
+        yield ctx.wait_signal("never")
+
+    w = _worker(box)
+    w.register_workflow("steady", steady)
+    w.register_query_handler(
+        "steady", lambda qtype, args: f"answer:{qtype}".encode()
+    )
+    w.start()
+    try:
+        _start(box, "sdk-wf7", "steady")
+        time.sleep(0.3)  # let the first (empty) decision complete
+        out = box.frontend.query_workflow(
+            DOMAIN, "sdk-wf7", query_type="depth", timeout_s=5.0
+        )
+        assert out == b"answer:depth"
+    finally:
+        w.stop()
