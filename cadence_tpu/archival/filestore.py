@@ -31,9 +31,10 @@ from .uri import URI, InvalidURIError
 
 def _safe(component: str) -> str:
     """Workflow/run ids are caller-controlled; percent-encode every path
-    separator (and '.') so ids like '../../x' cannot escape the archive
-    root (the reference filestore encodes these components too)."""
-    return quote(component, safe="") or "_"
+    separator AND '.' (quote leaves dots alone) so ids like '../../x'
+    cannot escape the archive root and dotted ids cannot collide in
+    the '{wid}.{rid}.json' naming scheme."""
+    return quote(component, safe="").replace(".", "%2E") or "_"
 
 
 def _atomic_write(path: str, data: str) -> None:

@@ -1,0 +1,10 @@
+"""Canary: live health-probe workflows.
+
+Reference: canary/ — const.go:64-84 lists the probe set (echo, signal,
+timeout, retry, concurrentExec, cron, query, reset, ...); sanity.go:54
+fans them out. run via ``python -m cadence_tpu.tools.cli canary``.
+"""
+
+from .runner import run_canary
+
+__all__ = ["run_canary"]

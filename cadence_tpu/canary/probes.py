@@ -1,0 +1,217 @@
+"""Canary probe workflows (reference canary/: echo.go, signal.go,
+timeout.go, retry.go, concurrentExec.go, query.go, reset.go).
+
+Each probe is (workflow fn + activities + driver fn); the driver runs
+against any frontend (local handler or gRPC stub) and asserts the
+outcome.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Callable, Dict
+
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.runtime.api import SignalRequest, StartWorkflowRequest
+
+TASK_LIST = "canary-tl"
+
+
+# -- workflow definitions -------------------------------------------------
+
+
+def echo_workflow(ctx, input):
+    out = yield ctx.schedule_activity("echo_activity", input)
+    return out
+
+
+def signal_workflow(ctx, input):
+    payload = yield ctx.wait_signal("canary-signal")
+    return b"signaled:" + payload
+
+
+def timer_workflow(ctx, input):
+    yield ctx.start_timer(1)
+    return b"timer-done"
+
+
+def retry_workflow(ctx, input):
+    from cadence_tpu.worker.sdk import ActivityError
+
+    attempts = 0
+    while True:
+        try:
+            out = yield ctx.schedule_activity("flaky_activity", input)
+            return out + b":after-" + str(attempts).encode() + b"-retries"
+        except ActivityError:
+            attempts += 1
+            if attempts > 3:
+                raise
+
+
+def concurrent_workflow(ctx, input):
+    results = []
+    for i in range(3):
+        r = yield ctx.start_child_workflow(
+            "canary-echo", f"canary-child-{input.decode()}-{i}",
+            input=str(i).encode(), task_list=TASK_LIST,
+        )
+        results.append(r)
+    return b",".join(results)
+
+
+def query_workflow(ctx, input):
+    yield ctx.wait_signal("done")
+    return b"ok"
+
+
+_flaky_counters: Dict[str, int] = {}
+
+
+def make_activities():
+    def echo_activity(data: bytes) -> bytes:
+        return data
+
+    def flaky_activity(data: bytes) -> bytes:
+        key = data.decode() or "default"
+        n = _flaky_counters.get(key, 0) + 1
+        _flaky_counters[key] = n
+        if n < 3:
+            raise RuntimeError(f"flaking (attempt {n})")
+        return b"succeeded"
+
+    return {"echo_activity": echo_activity, "flaky_activity": flaky_activity}
+
+
+WORKFLOWS: Dict[str, Callable] = {
+    "canary-echo": echo_workflow,
+    "canary-signal": signal_workflow,
+    "canary-timer": timer_workflow,
+    "canary-retry": retry_workflow,
+    "canary-concurrent": concurrent_workflow,
+    "canary-query": query_workflow,
+}
+
+
+# -- probe drivers --------------------------------------------------------
+
+
+def _wait_result(fe, domain, wf_id, run_id, timeout_s=20.0) -> bytes:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        desc = fe.describe_workflow_execution(domain, wf_id, run_id)
+        if not desc.is_running:
+            events, _ = fe.get_workflow_execution_history(
+                domain, wf_id, run_id
+            )
+            last = events[-1]
+            if last.event_type != EventType.WorkflowExecutionCompleted:
+                raise AssertionError(
+                    f"closed as {last.event_type.name}: {last.attributes}"
+                )
+            return last.attributes.get("result", b"")
+        time.sleep(0.05)
+    raise TimeoutError(f"{wf_id} still running after {timeout_s}s")
+
+
+def _start(fe, domain, wf_type, wf_id, input=b"", timeout=120):
+    return fe.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=domain, workflow_id=wf_id, workflow_type=wf_type,
+            task_list=TASK_LIST, input=input,
+            execution_start_to_close_timeout_seconds=timeout,
+        )
+    )
+
+
+def probe_echo(fe, domain) -> None:
+    wf = f"canary-echo-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-echo", wf, b"ping")
+    assert _wait_result(fe, domain, wf, run) == b"ping"
+
+
+def probe_signal(fe, domain) -> None:
+    wf = f"canary-signal-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-signal", wf)
+    fe.signal_workflow_execution(
+        SignalRequest(
+            domain=domain, workflow_id=wf,
+            signal_name="canary-signal", input=b"hello",
+        )
+    )
+    assert _wait_result(fe, domain, wf, run) == b"signaled:hello"
+
+
+def probe_timer(fe, domain) -> None:
+    wf = f"canary-timer-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-timer", wf)
+    assert _wait_result(fe, domain, wf, run) == b"timer-done"
+
+
+def probe_retry(fe, domain) -> None:
+    key = uuid.uuid4().hex[:8]
+    wf = f"canary-retry-{key}"
+    run = _start(fe, domain, "canary-retry", wf, key.encode())
+    out = _wait_result(fe, domain, wf, run)
+    assert out.startswith(b"succeeded"), out
+
+
+def probe_concurrent(fe, domain) -> None:
+    key = uuid.uuid4().hex[:8]
+    wf = f"canary-concurrent-{key}"
+    run = _start(fe, domain, "canary-concurrent", wf, key.encode())
+    assert _wait_result(fe, domain, wf, run) == b"0,1,2"
+
+
+def probe_query(fe, domain) -> None:
+    wf = f"canary-query-{uuid.uuid4().hex[:8]}"
+    _start(fe, domain, "canary-query", wf)
+    time.sleep(0.3)  # allow the first decision to settle
+    out = fe.query_workflow(
+        domain, wf, query_type="status", timeout_s=10.0
+    )
+    assert out == b"canary-query-alive", out
+
+
+def probe_visibility(fe, domain) -> None:
+    wf = f"canary-vis-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-echo", wf, b"v")
+    _wait_result(fe, domain, wf, run)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        n = fe.count_workflow_executions(
+            domain, f"WorkflowID = '{wf}' AND CloseStatus = 'COMPLETED'"
+        )
+        if n == 1:
+            return
+        time.sleep(0.1)
+    raise AssertionError("closed workflow never became visible")
+
+
+def probe_reset(fe, domain) -> None:
+    wf = f"canary-reset-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-echo", wf, b"r")
+    _wait_result(fe, domain, wf, run)
+    events, _ = fe.get_workflow_execution_history(domain, wf, run)
+    completed = [
+        e for e in events
+        if e.event_type == EventType.DecisionTaskCompleted
+    ][0]
+    new_run = fe.reset_workflow_execution(
+        domain, wf, run, reason="canary",
+        decision_finish_event_id=completed.event_id,
+    )
+    assert _wait_result(fe, domain, wf, new_run) == b"r"
+
+
+PROBES: Dict[str, Callable] = {
+    "echo": probe_echo,
+    "signal": probe_signal,
+    "timer": probe_timer,
+    "retry": probe_retry,
+    "concurrent": probe_concurrent,
+    "query": probe_query,
+    "visibility": probe_visibility,
+    "reset": probe_reset,
+}

@@ -25,6 +25,9 @@ from cadence_tpu.runtime.api import (
     StartWorkflowRequest,
 )
 from cadence_tpu.runtime.domains import DomainCache
+from cadence_tpu.runtime.persistence.errors import (
+    EntityNotExistsError as PersistenceEntityNotExistsError,
+)
 from cadence_tpu.utils.quotas import MultiStageRateLimiter
 
 from .domain_handler import DomainHandler
@@ -71,7 +74,12 @@ class WorkflowHandler:
             raise BadRequestError("domain name too long")
         if not self.limiter.allow(domain_name):
             raise ServiceBusyError(f"domain {domain_name} rate limit")
-        rec = self.domains.get_by_name(domain_name)
+        try:
+            rec = self.domains.get_by_name(domain_name)
+        except PersistenceEntityNotExistsError:
+            raise EntityNotExistsServiceError(
+                f"domain {domain_name} not found"
+            )
         if rec.info.status != 0:
             raise EntityNotExistsServiceError(
                 f"domain {domain_name} is deprecated"
@@ -263,7 +271,7 @@ class WorkflowHandler:
     def _activity_token_by_id(
         self, domain: str, workflow_id: str, run_id: str, activity_id: str
     ) -> Dict[str, Any]:
-        domain_id = self.domains.get_by_name(domain).info.id
+        domain_id = self._check(domain)
         desc = self.history.describe_workflow_execution(
             domain, workflow_id, run_id
         )

@@ -1,0 +1,14 @@
+"""RPC layer: the host↔host plane.
+
+Reference: common/rpc.go — YARPC dispatchers over TChannel. The
+TPU-native equivalent per SURVEY §2.8 is gRPC for the host plane
+(device↔device traffic rides ICI via jax collectives, never this
+layer). Uses gRPC generic handlers with a JSON+dataclass codec, so no
+IDL compilation step is needed.
+"""
+
+from .codec import decode, encode
+from .server import FrontendRPCServer
+from .client import RemoteFrontend
+
+__all__ = ["decode", "encode", "FrontendRPCServer", "RemoteFrontend"]

@@ -1,0 +1,83 @@
+"""gRPC client stub: a remote WorkflowHandler with the same surface.
+
+Any method on the server-side frontend/admin is callable by name; the
+stub re-raises the server's service errors as their local classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import grpc
+
+from cadence_tpu.frontend.domain_handler import DomainAlreadyExistsError
+from cadence_tpu.frontend.version_checker import ClientVersionNotSupportedError
+from cadence_tpu.runtime import api as A
+
+from . import codec
+
+_SERVICE = "cadence_tpu.Frontend"
+
+ERROR_TYPES = {
+    "BadRequestError": A.BadRequestError,
+    "EntityNotExistsServiceError": A.EntityNotExistsServiceError,
+    "EntityNotExistsError": A.EntityNotExistsServiceError,
+    "WorkflowExecutionAlreadyStartedServiceError": (
+        A.WorkflowExecutionAlreadyStartedServiceError
+    ),
+    "DomainAlreadyExistsError": DomainAlreadyExistsError,
+    "DomainNotActiveError": A.DomainNotActiveError,
+    "CancellationAlreadyRequestedError": A.CancellationAlreadyRequestedError,
+    "QueryFailedError": A.QueryFailedError,
+    "ServiceBusyError": A.ServiceBusyError,
+    "InternalServiceError": A.InternalServiceError,
+}
+
+
+class _Method:
+    def __init__(self, channel: grpc.Channel, name: str) -> None:
+        self._call = channel.unary_unary(
+            f"/{_SERVICE}/{name}",
+            request_serializer=codec.dumps,
+            response_deserializer=codec.loads_envelope,
+        )
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        try:
+            return self._call((list(args), kwargs))["r"]
+        except grpc.RpcError as e:
+            details = e.details() or ""
+            cls_name, _, msg = details.partition(": ")
+            exc_type = ERROR_TYPES.get(cls_name)
+            if exc_type is not None:
+                raise _build(exc_type, msg) from None
+            raise
+
+
+def _build(exc_type, msg):
+    try:
+        return exc_type(msg)
+    except TypeError:
+        e = exc_type.__new__(exc_type)
+        Exception.__init__(e, msg)
+        return e
+
+
+class RemoteFrontend:
+    """Dial a frontend; use exactly like a local WorkflowHandler."""
+
+    def __init__(self, address: str) -> None:
+        self.address = address
+        self._channel = grpc.insecure_channel(address)
+        self._methods = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        m = self._methods.get(name)
+        if m is None:
+            m = self._methods[name] = _Method(self._channel, name)
+        return m
+
+    def close(self) -> None:
+        self._channel.close()

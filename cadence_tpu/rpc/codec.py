@@ -1,0 +1,124 @@
+"""Wire codec: JSON with dataclass/bytes/enum/tuple envelopes.
+
+Replaces the reference's thrift envelope (common/codec/
+version0Thriftrw.go): every API type crossing the host plane is a
+registered dataclass; bytes are base64; enums are ints; tuples are
+tagged so (events, token) responses round-trip.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import enum
+import json
+from typing import Any, Dict
+
+from cadence_tpu.core.events import HistoryEvent, RetryPolicy
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _register_defaults() -> None:
+    from cadence_tpu.runtime import api as A
+    from cadence_tpu.runtime.persistence import records as R
+
+    for cls in (
+        A.StartWorkflowRequest,
+        A.SignalRequest,
+        A.SignalWithStartRequest,
+        A.Decision,
+        A.PollForDecisionTaskResponse,
+        A.PollForActivityTaskResponse,
+        A.DescribeWorkflowResponse,
+        R.DomainInfo,
+        R.DomainConfig,
+        R.DomainReplicationConfig,
+        R.DomainRecord,
+        R.VisibilityRecord,
+        R.TaskListInfo,
+        RetryPolicy,
+    ):
+        register(cls)
+
+
+def encode(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return {"__b": base64.b64encode(obj).decode()}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, HistoryEvent):
+        return {"__ev": obj.to_dict()}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        name = type(obj).__name__
+        return {
+            "__dc": name,
+            "f": {
+                fld.name: encode(getattr(obj, fld.name))
+                for fld in dataclasses.fields(obj)
+            },
+        }
+    if isinstance(obj, tuple):
+        return {"__t": [encode(v) for v in obj]}
+    if isinstance(obj, list):
+        return [encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    if isinstance(obj, (set, frozenset)):
+        return {"__t": [encode(v) for v in sorted(obj)]}
+    raise TypeError(f"cannot encode {type(obj).__name__}")
+
+
+def decode(obj: Any) -> Any:
+    if isinstance(obj, list):
+        return [decode(v) for v in obj]
+    if isinstance(obj, dict):
+        if "__b" in obj and len(obj) == 1:
+            return base64.b64decode(obj["__b"])
+        if "__ev" in obj and len(obj) == 1:
+            return HistoryEvent.from_dict(obj["__ev"])
+        if "__t" in obj and len(obj) == 1:
+            return tuple(decode(v) for v in obj["__t"])
+        if "__dc" in obj:
+            if not _REGISTRY:
+                _register_defaults()
+            cls = _REGISTRY.get(obj["__dc"])
+            if cls is None:
+                raise TypeError(f"unknown wire type {obj['__dc']}")
+            return cls(**{k: decode(v) for k, v in obj["f"].items()})
+        return {k: decode(v) for k, v in obj.items()}
+    return obj
+
+
+def dumps(obj: Any) -> bytes:
+    if not _REGISTRY:
+        _register_defaults()
+    return json.dumps(encode(obj)).encode()
+
+
+def loads(raw: bytes) -> Any:
+    if not _REGISTRY:
+        _register_defaults()
+    return decode(json.loads(raw.decode()))
+
+
+# grpc-python treats a deserializer returning None as a deserialization
+# FAILURE (grpc/_channel.py "Exception deserializing response!"), so
+# void RPC results must ride in an envelope.
+
+
+def dumps_enveloped(obj: Any) -> bytes:
+    return dumps({"r": obj})
+
+
+def loads_envelope(raw: bytes) -> Any:
+    """Returns the ENVELOPE dict — the deserializer result itself must
+    never be None (grpc reads that as failure); callers unwrap ["r"]."""
+    return loads(raw)

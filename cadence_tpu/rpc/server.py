@@ -1,0 +1,95 @@
+"""gRPC server over generic handlers: the frontend's network endpoint.
+
+Reference: common/rpc.go dispatcher + service/frontend Thrift server.
+Methods are dispatched by name to the WorkflowHandler/AdminHandler;
+requests/responses ride the JSON codec; service errors map to gRPC
+status codes with the error class in the details for client-side
+re-raise.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from cadence_tpu.runtime import api as A
+
+from . import codec
+
+_SERVICE = "cadence_tpu.Frontend"
+
+# error class name → grpc status (client reverses via ERROR_TYPES)
+ERROR_CODES = {
+    "BadRequestError": grpc.StatusCode.INVALID_ARGUMENT,
+    "EntityNotExistsServiceError": grpc.StatusCode.NOT_FOUND,
+    "EntityNotExistsError": grpc.StatusCode.NOT_FOUND,
+    "WorkflowExecutionAlreadyStartedServiceError": (
+        grpc.StatusCode.ALREADY_EXISTS
+    ),
+    "DomainAlreadyExistsError": grpc.StatusCode.ALREADY_EXISTS,
+    "DomainNotActiveError": grpc.StatusCode.FAILED_PRECONDITION,
+    "CancellationAlreadyRequestedError": grpc.StatusCode.ALREADY_EXISTS,
+    "QueryFailedError": grpc.StatusCode.FAILED_PRECONDITION,
+    "ServiceBusyError": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    "ClientVersionNotSupportedError": grpc.StatusCode.FAILED_PRECONDITION,
+    "InternalServiceError": grpc.StatusCode.INTERNAL,
+}
+
+
+class _Generic(grpc.GenericRpcHandler):
+    def __init__(self, targets) -> None:
+        self._targets = targets  # list of handler objects, first match
+
+    def _resolve(self, name: str):
+        for target in self._targets:
+            fn = getattr(target, name, None)
+            if fn is not None and callable(fn) and not name.startswith("_"):
+                return fn
+        return None
+
+    def service(self, call_details):
+        prefix = f"/{_SERVICE}/"
+        if not call_details.method.startswith(prefix):
+            return None
+        name = call_details.method[len(prefix):]
+        fn = self._resolve(name)
+        if fn is None:
+            return None
+
+        def handler(request, context):
+            args, kwargs = request
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                cls = type(e).__name__
+                code = ERROR_CODES.get(cls, grpc.StatusCode.INTERNAL)
+                context.abort(code, f"{cls}: {e}")
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=codec.loads,
+            response_serializer=codec.dumps_enveloped,
+        )
+
+
+class FrontendRPCServer:
+    def __init__(
+        self, frontend, admin=None, address: str = "127.0.0.1:0",
+        max_workers: int = 16,
+    ) -> None:
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        targets = [frontend] + ([admin] if admin is not None else [])
+        self._server.add_generic_rpc_handlers((_Generic(targets),))
+        self.port = self._server.add_insecure_port(address)
+        self.address = f"127.0.0.1:{self.port}"
+
+    def start(self) -> "FrontendRPCServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)

@@ -1,0 +1,107 @@
+"""Onebox: a full cluster in one process.
+
+Reference: host/onebox.go:69 — frontend + matching + history + worker
+wired over real persistence with static membership (host/simpleMonitor
+.go), the backbone of the reference's integration suite. Used here by
+integration tests, the CLI's embedded server, and the canary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.cluster import ClusterMetadata
+from cadence_tpu.frontend import (
+    AdminHandler,
+    DCRedirectionHandler,
+    DomainHandler,
+    WorkflowHandler,
+)
+from cadence_tpu.matching import MatchingEngine
+from cadence_tpu.messaging import MessageBus
+from cadence_tpu.runtime.domains import DomainCache
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.service import HistoryService
+from cadence_tpu.visibility import AdvancedVisibilityStore
+from cadence_tpu.worker.archiver import ArchivalClient
+from cadence_tpu.worker.service import WorkerService
+
+
+class Onebox:
+    def __init__(
+        self,
+        num_shards: int = 4,
+        persistence=None,
+        cluster_metadata: Optional[ClusterMetadata] = None,
+        host_identity: str = "onebox-0",
+        start_worker: bool = True,
+        queue_worker_count: int = 4,
+    ) -> None:
+        self.persistence = persistence or create_memory_bundle()
+        self.bus = MessageBus()
+        self.cluster_metadata = cluster_metadata or ClusterMetadata()
+        self.domain_handler = DomainHandler(
+            self.persistence.metadata, self.cluster_metadata,
+            replication_producer=self.bus.new_producer("domain-replication"),
+        )
+        self.domains = DomainCache(self.persistence.metadata)
+        self.monitor = single_host_monitor(host_identity)
+        self.history = HistoryService(
+            num_shards, self.persistence, self.domains, self.monitor,
+            cluster_metadata=self.cluster_metadata,
+            queue_worker_count=queue_worker_count,
+        )
+        self.history_client = HistoryClient(self.history.controller)
+        self.matching = MatchingEngine(
+            self.persistence.task, self.history_client
+        )
+        self.matching_client = MatchingClient(self.matching)
+        self.history.wire(self.matching_client, self.history_client)
+        self.visibility = AdvancedVisibilityStore(self.persistence.visibility)
+        self.frontend = WorkflowHandler(
+            self.domain_handler, self.domains,
+            self.history_client, self.matching_client,
+            visibility=self.visibility,
+        )
+        self.admin = AdminHandler(self.history, self.domains)
+        self.worker: Optional[WorkerService] = None
+        self._start_worker = start_worker
+        self._started = False
+
+    def start(self) -> "Onebox":
+        if self._started:
+            return self
+        self.history.start()
+        if self._start_worker:
+            self.worker = WorkerService(
+                self.frontend, self.persistence,
+                num_shards=self.history.controller.num_shards,
+                bus=self.bus, domain_handler=self.domain_handler,
+                history_service=self.history,
+            )
+            # archival trigger on every shard's close processor
+            client = ArchivalClient(self.frontend, self.domains)
+            for shard_id in self.history.controller.owned_shards():
+                handle = self.history.controller._handles[shard_id]
+                for p in handle.processors:
+                    if hasattr(p, "_process_close"):
+                        p.archival_client = client
+            self.worker.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if self.worker is not None:
+            self.worker.stop()
+        self.history.stop()
+        self.matching.shutdown()
+        self.bus.close()
+        self._started = False
+
+    def __enter__(self) -> "Onebox":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,1 @@
+"""Operator tooling (reference: tools/cli, tools/cassandra, tools/sql)."""

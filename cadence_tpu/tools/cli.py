@@ -1,0 +1,366 @@
+"""The operator CLI.
+
+Reference: tools/cli/ (app.go, domainCommands.go, workflowCommands.go,
+adminCommands.go) — domain CRUD/failover, workflow
+start/show/signal/terminate/cancel/reset/query/list, task-list
+describe, admin shard/host introspection, batch operations, plus
+``server`` (cmd/server/cadence.go start) which boots a onebox over
+sqlite with the gRPC endpoint.
+
+Usage:
+    python -m cadence_tpu.tools.cli server --db /tmp/c.db --port 7933
+    python -m cadence_tpu.tools.cli --address 127.0.0.1:7933 \\
+        domain register --name dev
+    python -m cadence_tpu.tools.cli --address 127.0.0.1:7933 \\
+        workflow start --domain dev --workflow-id w1 --type t --tasklist tl
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import signal
+import sys
+import time
+from typing import Any
+
+
+def _print(obj: Any) -> None:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        obj = dataclasses.asdict(obj)
+    if isinstance(obj, (dict, list)):
+        print(json.dumps(obj, indent=2, default=_default))
+    else:
+        print(obj)
+
+
+def _default(o: Any) -> Any:
+    if isinstance(o, bytes):
+        try:
+            return o.decode()
+        except UnicodeDecodeError:
+            return o.hex()
+    if dataclasses.is_dataclass(o) and not isinstance(o, type):
+        return dataclasses.asdict(o)
+    return str(o)
+
+
+def _frontend(args):
+    from cadence_tpu.rpc import RemoteFrontend
+
+    if not args.address:
+        sys.exit("--address is required (or run `server` first)")
+    return RemoteFrontend(args.address)
+
+
+# -- server ---------------------------------------------------------------
+
+
+def cmd_server(args) -> None:
+    from cadence_tpu.rpc import FrontendRPCServer
+    from cadence_tpu.runtime.persistence.sqlite import create_sqlite_bundle
+    from cadence_tpu.testing.onebox import Onebox
+
+    persistence = (
+        create_sqlite_bundle(args.db) if args.db else None
+    )
+    box = Onebox(
+        num_shards=args.shards,
+        persistence=persistence,
+        start_worker=not args.no_worker,
+    ).start()
+    server = FrontendRPCServer(
+        box.frontend, box.admin, address=f"127.0.0.1:{args.port}"
+    ).start()
+    print(f"cadence-tpu server listening on {server.address} "
+          f"(shards={args.shards}, db={args.db or 'memory'})")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+        box.stop()
+
+
+# -- domain ---------------------------------------------------------------
+
+
+def cmd_domain(args) -> None:
+    fe = _frontend(args)
+    if args.domain_cmd == "register":
+        out = fe.register_domain(
+            args.name, description=args.description or "",
+            retention_days=args.retention,
+            is_global=args.global_domain,
+            clusters=args.clusters.split(",") if args.clusters else None,
+            active_cluster=args.active_cluster or "",
+        )
+        _print({"domain_id": out})
+    elif args.domain_cmd == "describe":
+        _print(fe.describe_domain(name=args.name))
+    elif args.domain_cmd == "list":
+        _print(fe.list_domains())
+    elif args.domain_cmd == "update":
+        kwargs = {}
+        if args.description is not None:
+            kwargs["description"] = args.description
+        if args.retention:
+            kwargs["retention_days"] = args.retention
+        if args.add_bad_binary:
+            kwargs["add_bad_binary"] = {
+                "checksum": args.add_bad_binary, "reason": args.reason or ""
+            }
+        _print(fe.update_domain(args.name, **kwargs))
+    elif args.domain_cmd == "failover":
+        _print(fe.update_domain(args.name, active_cluster=args.to))
+    elif args.domain_cmd == "deprecate":
+        fe.deprecate_domain(args.name)
+        _print({"deprecated": args.name})
+
+
+# -- workflow -------------------------------------------------------------
+
+
+def cmd_workflow(args) -> None:
+    from cadence_tpu.runtime.api import SignalRequest, StartWorkflowRequest
+
+    fe = _frontend(args)
+    wc = args.workflow_cmd
+    if wc == "start":
+        run_id = fe.start_workflow_execution(
+            StartWorkflowRequest(
+                domain=args.domain, workflow_id=args.workflow_id,
+                workflow_type=args.type, task_list=args.tasklist,
+                input=(args.input or "").encode(),
+                execution_start_to_close_timeout_seconds=args.timeout,
+                cron_schedule=args.cron or "",
+            )
+        )
+        _print({"run_id": run_id})
+    elif wc == "show":
+        events, _ = fe.get_workflow_execution_history(
+            args.domain, args.workflow_id, args.run_id or ""
+        )
+        _print([
+            {
+                "id": e.event_id,
+                "type": e.event_type.name,
+                "version": e.version,
+                "attributes": {
+                    k: v for k, v in e.attributes.items() if v not in
+                    (None, "", b"")
+                },
+            }
+            for e in events
+        ])
+    elif wc == "describe":
+        _print(fe.describe_workflow_execution(
+            args.domain, args.workflow_id, args.run_id or ""
+        ))
+    elif wc == "signal":
+        fe.signal_workflow_execution(
+            SignalRequest(
+                domain=args.domain, workflow_id=args.workflow_id,
+                run_id=args.run_id or "", signal_name=args.name,
+                input=(args.input or "").encode(),
+            )
+        )
+        _print({"signaled": args.workflow_id})
+    elif wc == "terminate":
+        fe.terminate_workflow_execution(
+            args.domain, args.workflow_id, args.run_id or "",
+            reason=args.reason or "terminated via cli",
+        )
+        _print({"terminated": args.workflow_id})
+    elif wc == "cancel":
+        fe.request_cancel_workflow_execution(
+            args.domain, args.workflow_id, args.run_id or ""
+        )
+        _print({"cancel_requested": args.workflow_id})
+    elif wc == "reset":
+        new_run = fe.reset_workflow_execution(
+            args.domain, args.workflow_id, args.run_id or "",
+            reason=args.reason or "reset via cli",
+            decision_finish_event_id=args.event_id,
+        )
+        _print({"new_run_id": new_run})
+    elif wc == "query":
+        out = fe.query_workflow(
+            args.domain, args.workflow_id, args.run_id or "",
+            query_type=args.type, timeout_s=args.timeout,
+        )
+        _print({"result": out.decode(errors="replace")})
+    elif wc == "list":
+        recs, _ = fe.list_workflow_executions(
+            args.domain, args.query or "", page_size=args.page_size
+        )
+        _print(recs)
+    elif wc == "count":
+        _print({"count": fe.count_workflow_executions(
+            args.domain, args.query or ""
+        )})
+
+
+# -- tasklist / admin / batch --------------------------------------------
+
+
+def cmd_tasklist(args) -> None:
+    fe = _frontend(args)
+    _print(fe.describe_task_list(args.domain, args.name, args.task_type))
+
+
+def cmd_admin(args) -> None:
+    fe = _frontend(args)
+    if args.admin_cmd == "describe-host":
+        _print(fe.describe_history_host())
+    elif args.admin_cmd == "close-shard":
+        fe.close_shard(args.shard_id)
+        _print({"closed": args.shard_id})
+    elif args.admin_cmd == "describe-workflow":
+        _print(fe.describe_workflow_execution(
+            args.domain, args.workflow_id, args.run_id or ""
+        ))
+
+
+def cmd_batch(args) -> None:
+    from cadence_tpu.runtime.api import StartWorkflowRequest
+    from cadence_tpu.worker.batcher import (
+        BATCHER_TASK_LIST,
+        BATCHER_WORKFLOW_TYPE,
+    )
+    from cadence_tpu.worker.service import SYSTEM_DOMAIN
+
+    fe = _frontend(args)
+    payload = json.dumps({
+        "operation": args.operation,
+        "domain": args.domain,
+        "query": args.query or "",
+        "params": {
+            "reason": args.reason or "batch via cli",
+            "signal_name": args.signal_name or "",
+            "signal_input": args.input or "",
+        },
+    }).encode()
+    run_id = fe.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=SYSTEM_DOMAIN,
+            workflow_id=f"cli-batch-{int(time.time())}",
+            workflow_type=BATCHER_WORKFLOW_TYPE,
+            task_list=BATCHER_TASK_LIST, input=payload,
+            execution_start_to_close_timeout_seconds=3600,
+        )
+    )
+    _print({"batch_run_id": run_id})
+
+
+def cmd_canary(args) -> None:
+    from cadence_tpu.canary.runner import run_canary
+
+    results = run_canary(
+        address=args.address, probes=args.probes.split(",") if args.probes
+        else None,
+    )
+    _print(results)
+    if any(not r["ok"] for r in results):
+        sys.exit(1)
+
+
+# -- parser ---------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cadence-tpu")
+    p.add_argument("--address", default="",
+                   help="frontend gRPC address (host:port)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run a onebox server")
+    s.add_argument("--db", default="", help="sqlite path (default memory)")
+    s.add_argument("--port", type=int, default=7933)
+    s.add_argument("--shards", type=int, default=4)
+    s.add_argument("--no-worker", action="store_true")
+    s.set_defaults(fn=cmd_server)
+
+    d = sub.add_parser("domain")
+    dsub = d.add_subparsers(dest="domain_cmd", required=True)
+    for name in ("register", "describe", "update", "deprecate"):
+        dp = dsub.add_parser(name)
+        dp.add_argument("--name", required=True)
+        dp.add_argument("--description")
+        dp.add_argument("--retention", type=int, default=7)
+        dp.add_argument("--global-domain", action="store_true")
+        dp.add_argument("--clusters", default="")
+        dp.add_argument("--active-cluster", default="")
+        dp.add_argument("--add-bad-binary", default="")
+        dp.add_argument("--reason", default="")
+    dl = dsub.add_parser("list")
+    df = dsub.add_parser("failover")
+    df.add_argument("--name", required=True)
+    df.add_argument("--to", required=True)
+    d.set_defaults(fn=cmd_domain)
+
+    w = sub.add_parser("workflow")
+    wsub = w.add_subparsers(dest="workflow_cmd", required=True)
+    for name in ("start", "show", "describe", "signal", "terminate",
+                 "cancel", "reset", "query", "list", "count"):
+        wp = wsub.add_parser(name)
+        wp.add_argument("--domain", required=True)
+        if name not in ("list", "count"):
+            wp.add_argument("--workflow-id", required=True)
+        wp.add_argument("--run-id", default="")
+        wp.add_argument("--type", default="")
+        wp.add_argument("--tasklist", default="")
+        wp.add_argument("--input", default="")
+        wp.add_argument("--name", default="")
+        wp.add_argument("--reason", default="")
+        wp.add_argument("--query", default="")
+        wp.add_argument("--cron", default="")
+        wp.add_argument("--event-id", type=int, default=0)
+        wp.add_argument("--timeout", type=int, default=60)
+        wp.add_argument("--page-size", type=int, default=100)
+    w.set_defaults(fn=cmd_workflow)
+
+    t = sub.add_parser("tasklist")
+    t.add_argument("--domain", required=True)
+    t.add_argument("--name", required=True)
+    t.add_argument("--task-type", type=int, default=0)
+    t.set_defaults(fn=cmd_tasklist)
+
+    a = sub.add_parser("admin")
+    asub = a.add_subparsers(dest="admin_cmd", required=True)
+    asub.add_parser("describe-host")
+    acs = asub.add_parser("close-shard")
+    acs.add_argument("--shard-id", type=int, required=True)
+    adw = asub.add_parser("describe-workflow")
+    adw.add_argument("--domain", required=True)
+    adw.add_argument("--workflow-id", required=True)
+    adw.add_argument("--run-id", default="")
+    a.set_defaults(fn=cmd_admin)
+
+    b = sub.add_parser("batch")
+    b.add_argument("--operation", required=True,
+                   choices=("terminate", "cancel", "signal"))
+    b.add_argument("--domain", required=True)
+    b.add_argument("--query", default="")
+    b.add_argument("--reason", default="")
+    b.add_argument("--signal-name", default="")
+    b.add_argument("--input", default="")
+    b.set_defaults(fn=cmd_batch)
+
+    c = sub.add_parser("canary", help="run health-probe workflows")
+    c.add_argument("--probes", default="")
+    c.set_defaults(fn=cmd_canary)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

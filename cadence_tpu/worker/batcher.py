@@ -35,6 +35,8 @@ class BatcherActivities:
     def run_batch(self, payload: bytes) -> bytes:
         req = json.loads(payload)
         operation = req["operation"]
+        if operation not in ("terminate", "cancel", "signal"):
+            raise ValueError(f"unknown operation {operation!r}")
         domain = req["domain"]
         params = req.get("params", {})
         targets = self._targets(req)
@@ -61,8 +63,6 @@ class BatcherActivities:
                             ).encode(),
                         )
                     )
-                else:
-                    raise ValueError(f"unknown operation {operation!r}")
                 done += 1
             except Exception as e:
                 errors.append(f"{wf_id}: {e}")

@@ -60,6 +60,16 @@ def parent_close_policy_workflow(ctx, input: bytes):
             start_to_close_timeout_seconds=300,
         )
         handled += 1
+    # drain signals recorded but unconsumed — continue-as-new would
+    # orphan those close requests (same pattern as archival_workflow)
+    while True:
+        payload = yield ctx.poll_signal(PCP_SIGNAL)
+        if payload is None:
+            break
+        yield ctx.schedule_activity(
+            "apply_parent_close_policy", payload,
+            start_to_close_timeout_seconds=300,
+        )
     yield ctx.continue_as_new(b"")
 
 

@@ -23,16 +23,15 @@ SCANNER_TASK_LIST = "cadence-scanner-tl"
 
 def scanner_workflow(ctx, input: bytes):
     """One pass of every scavenger, then sleep and continue-as-new."""
-    summary = yield ctx.schedule_activity(
+    yield ctx.schedule_activity(
         "scavenge_task_lists", b"", start_to_close_timeout_seconds=300,
     )
-    summary2 = yield ctx.schedule_activity(
+    yield ctx.schedule_activity(
         "scavenge_history", b"", start_to_close_timeout_seconds=300,
     )
     interval = int(input or b"60")
     yield ctx.start_timer(interval)
     yield ctx.continue_as_new(input)
-    return summary + b"|" + summary2
 
 
 class ScannerActivities:
@@ -44,10 +43,13 @@ class ScannerActivities:
         num_shards: int = 0,
         idle_task_list_age_s: float = 3600.0,
         now=time.time,
+        matching=None,
     ) -> None:
         self.tasks = task_manager
         self.history = history_manager
         self.execution = execution_manager
+        # optional: consulted for live pollers before deleting a list
+        self.matching = matching
         self.num_shards = num_shards
         self.idle_age = idle_task_list_age_s
         self.now = now
@@ -74,6 +76,8 @@ class ScannerActivities:
             age = self.now() - info.last_updated / 1e9
             if age < self.idle_age:
                 continue
+            if self._has_recent_pollers(info):
+                continue
             try:
                 self.tasks.delete_task_list(
                     info.domain_id, info.name, info.task_type,
@@ -83,6 +87,23 @@ class ScannerActivities:
             except Exception:
                 continue  # raced with a new lease: leave it
         return json.dumps({"scanned": scanned, "deleted": deleted}).encode()
+
+    def _has_recent_pollers(self, info) -> bool:
+        """Live long-pollers don't bump last_updated; ask matching
+        (reference: scavenger consults DescribeTaskList pollers)."""
+        if self.matching is None:
+            return False
+        try:
+            desc = self.matching.describe_task_list(
+                info.domain_id, info.name, info.task_type
+            )
+        except Exception:
+            return True  # can't tell: keep the list
+        pollers = (
+            desc.get("pollers", []) if isinstance(desc, dict)
+            else getattr(desc, "pollers", [])
+        )
+        return bool(pollers)
 
     # -- history scavenger (history/scavenger.go) ----------------------
 

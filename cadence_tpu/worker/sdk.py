@@ -173,9 +173,10 @@ class _ReplayState:
         # timer_id → fired?
         self.timers_started: set = set()
         self.timers_fired: set = set()
-        # child workflow_id → outcome
+        # child outcomes by INITIATION ORDER (a workflow may start the
+        # same child workflow_id again after it closes)
         self.children_started: set = set()
-        self.child_outcome: Dict[str, Tuple] = {}
+        self.child_outcome_by_index: Dict[int, Tuple] = {}
         # signals by name (FIFO)
         self.signals: Dict[str, List[bytes]] = {}
         # history-ordered initiation lists: replay matches the Nth yield
@@ -228,12 +229,12 @@ class _ReplayState:
             elif et == EventType.StartChildWorkflowExecutionInitiated:
                 wid = a.get("workflow_id", "")
                 self.children_started.add(wid)
+                init_to_child[e.event_id] = len(self.children_list)
                 self.children_list.append(wid)
-                init_to_child[e.event_id] = wid
             elif et == EventType.ChildWorkflowExecutionCompleted:
-                wid = init_to_child.get(a.get("initiated_event_id"))
-                if wid:
-                    self.child_outcome[wid] = (
+                idx = init_to_child.get(a.get("initiated_event_id"))
+                if idx is not None:
+                    self.child_outcome_by_index[idx] = (
                         "completed", a.get("result", b"")
                     )
             elif et in (
@@ -243,11 +244,13 @@ class _ReplayState:
                 EventType.ChildWorkflowExecutionTerminated,
                 EventType.StartChildWorkflowExecutionFailed,
             ):
-                wid = init_to_child.get(
-                    a.get("initiated_event_id")
-                ) or a.get("workflow_id", "")
-                if wid:
-                    self.child_outcome[wid] = (
+                idx = init_to_child.get(a.get("initiated_event_id"))
+                if idx is None and a.get("workflow_id", "") in (
+                    self.children_list
+                ):
+                    idx = self.children_list.index(a["workflow_id"])
+                if idx is not None:
+                    self.child_outcome_by_index[idx] = (
                         "failed", a.get("reason", str(et)), b""
                     )
             elif et == EventType.SignalExternalWorkflowExecutionInitiated:
@@ -382,7 +385,7 @@ class _Driver:
             wid = cmd.workflow_id
             child_idx = self.seq["c"]
             self.seq["c"] += 1
-            outcome = st.child_outcome.get(wid)
+            outcome = st.child_outcome_by_index.get(child_idx)
             if outcome is not None:
                 if outcome[0] == "completed":
                     return outcome[1], None, False
@@ -473,10 +476,12 @@ class WorkflowRegistry:
 
 
 def replay_decide(
-    registry: WorkflowRegistry, history: List[HistoryEvent]
+    registry: WorkflowRegistry, history: List[HistoryEvent],
+    state: Optional[_ReplayState] = None,
 ) -> List[Decision]:
     """Pure function: full history → this decision's output."""
-    state = _ReplayState(history)
+    if state is None:
+        state = _ReplayState(history)
     fn = registry.workflow(state.workflow_type)
     return _Driver(fn, state).run()
 
@@ -506,7 +511,7 @@ class DecisionWorker:
             return True
         state = _ReplayState(task.history)
         try:
-            decisions = replay_decide(self.registry, task.history)
+            decisions = replay_decide(self.registry, task.history, state)
         except Exception:
             self.frontend.respond_decision_task_failed(
                 task.task_token, identity=self.identity,

@@ -58,6 +58,9 @@ class WorkerService:
                 build_scanner_worker(
                     frontend, persistence.task, persistence.history,
                     persistence.execution, num_shards=num_shards,
+                    matching=frontend.matching if hasattr(
+                        frontend, "matching"
+                    ) else None,
                 )
             )
         if enable_batcher:

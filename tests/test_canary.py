@@ -1,0 +1,15 @@
+"""Canary suite as an integration test (reference canary/sanity.go)."""
+
+from __future__ import annotations
+
+from cadence_tpu.canary import run_canary
+
+
+def test_all_probes_pass():
+    results = run_canary()
+    failures = [r for r in results if not r["ok"]]
+    assert not failures, failures
+    assert {r["probe"] for r in results} == {
+        "echo", "signal", "timer", "retry", "concurrent", "query",
+        "visibility", "reset",
+    }
