@@ -1,0 +1,260 @@
+"""ctypes bindings for the C++ packing/transport sidecar.
+
+Builds native/sidecar.cpp on first use (g++ -O3 -shared, cached in the
+source tree next to the .cpp) and exposes:
+
+- scatter_time_major / scatter_batch_major — fused pad+layout of ragged
+  event rows into the dense tensors the replay scan consumes
+- fnv1a32_batch — bulk id hashing for slot keys
+- tensor_compress / tensor_decompress — varint+zigzag delta codec for
+  shipping packed tensors across hosts
+
+Every entry point has a pure-Python/numpy fallback (`HAVE_NATIVE` tells
+which path is live), and the test suite runs both differentially.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "native", "sidecar.cpp",
+)
+_LIB_PATH = os.path.join(os.path.dirname(_SRC), "libctsidecar.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+HAVE_NATIVE = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_LIB_PATH) and (
+        os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
+    ):
+        return _LIB_PATH
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", _LIB_PATH, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return _LIB_PATH
+    except Exception:
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, HAVE_NATIVE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.ct_scatter_time_major.argtypes = [
+            i32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, i32p,
+        ]
+        lib.ct_scatter_batch_major.argtypes = (
+            lib.ct_scatter_time_major.argtypes
+        )
+        lib.ct_fnv1a32_batch.argtypes = [
+            ctypes.c_char_p, i64p, ctypes.c_int64, u32p,
+        ]
+        lib.ct_compress_bound.argtypes = [ctypes.c_int64]
+        lib.ct_compress_bound.restype = ctypes.c_int64
+        lib.ct_tensor_compress.argtypes = [i32p, ctypes.c_int64, u8p]
+        lib.ct_tensor_compress.restype = ctypes.c_int64
+        lib.ct_tensor_decompress.argtypes = [u8p, ctypes.c_int64, i32p]
+        lib.ct_tensor_decompress.restype = ctypes.c_int64
+        lib.ct_tensor_peek_count.argtypes = [u8p]
+        lib.ct_tensor_peek_count.restype = ctypes.c_int64
+        _lib = lib
+        HAVE_NATIVE = True
+        return lib
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+# -- scatter ---------------------------------------------------------------
+
+
+def scatter_time_major(
+    rows: np.ndarray, lengths: np.ndarray, max_events: int,
+    type_pad: int = -1, force_python: bool = False,
+) -> np.ndarray:
+    """[sum(lengths), E] rows + [B] lengths → [T, B, E] dense tensor."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lengths64 = np.ascontiguousarray(lengths, dtype=np.int64)
+    batch = len(lengths64)
+    ev_n = rows.shape[1] if rows.ndim == 2 else 0
+    lib = None if force_python else _load()
+    if lib is not None and ev_n and rows.size:
+        out = np.empty((max_events, batch, ev_n), dtype=np.int32)
+        lib.ct_scatter_time_major(
+            _i32p(rows), _i64p(lengths64), batch, ev_n, max_events,
+            type_pad, _i32p(out),
+        )
+        return out
+    # numpy fallback
+    out = np.zeros((max_events, batch, ev_n), dtype=np.int32)
+    if ev_n:
+        out[:, :, 0] = type_pad
+    start = 0
+    for b, n in enumerate(lengths64):
+        out[:n, b, :] = rows[start : start + n]
+        start += n
+    return out
+
+
+def scatter_batch_major(
+    rows: np.ndarray, lengths: np.ndarray, max_events: int,
+    type_pad: int = -1, force_python: bool = False,
+) -> np.ndarray:
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lengths64 = np.ascontiguousarray(lengths, dtype=np.int64)
+    batch = len(lengths64)
+    ev_n = rows.shape[1] if rows.ndim == 2 else 0
+    lib = None if force_python else _load()
+    if lib is not None and ev_n and rows.size:
+        out = np.empty((batch, max_events, ev_n), dtype=np.int32)
+        lib.ct_scatter_batch_major(
+            _i32p(rows), _i64p(lengths64), batch, ev_n, max_events,
+            type_pad, _i32p(out),
+        )
+        return out
+    out = np.zeros((batch, max_events, ev_n), dtype=np.int32)
+    if ev_n:
+        out[:, :, 0] = type_pad
+    start = 0
+    for b, n in enumerate(lengths64):
+        out[b, :n, :] = rows[start : start + n]
+        start += n
+    return out
+
+
+# -- hashing ---------------------------------------------------------------
+
+
+def fnv1a32_batch(strings, force_python: bool = False) -> np.ndarray:
+    """hash31 for a batch of strings (cadence_tpu.utils.hashing)."""
+    lib = None if force_python else _load()
+    if lib is None:
+        from cadence_tpu.utils.hashing import hash31
+
+        return np.array([hash31(s) for s in strings], dtype=np.uint32)
+    encoded = [s.encode() for s in strings]
+    data = b"".join(encoded)
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    out = np.empty(len(encoded), dtype=np.uint32)
+    lib.ct_fnv1a32_batch(
+        data, _i64p(offsets), len(encoded),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out
+
+
+# -- transport codec -------------------------------------------------------
+
+
+def tensor_compress(
+    tensor: np.ndarray, force_python: bool = False
+) -> Tuple[bytes, Tuple[int, ...]]:
+    """int32 tensor → (blob, shape). Delta+zigzag+varint."""
+    flat = np.ascontiguousarray(tensor, dtype=np.int32).reshape(-1)
+    lib = None if force_python else _load()
+    if lib is None:
+        return _py_compress(flat), tensor.shape
+    bound = lib.ct_compress_bound(flat.size)
+    buf = np.empty(bound, dtype=np.uint8)
+    n = lib.ct_tensor_compress(
+        _i32p(flat), flat.size,
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return bytes(buf[:n]), tensor.shape
+
+
+def tensor_decompress(
+    blob: bytes, shape: Tuple[int, ...], force_python: bool = False
+) -> np.ndarray:
+    lib = None if force_python else _load()
+    if lib is None:
+        return _py_decompress(blob).reshape(shape)
+    raw = np.frombuffer(blob, dtype=np.uint8)
+    count = lib.ct_tensor_peek_count(
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    )
+    out = np.empty(count, dtype=np.int32)
+    lib.ct_tensor_decompress(
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(blob),
+        _i32p(out),
+    )
+    return out.reshape(shape)
+
+
+def _py_compress(flat: np.ndarray) -> bytes:
+    out = bytearray()
+
+    def put(v: int) -> None:
+        while v >= 0x80:
+            out.append((v & 0x7F) | 0x80)
+            v >>= 7
+        out.append(v)
+
+    put(flat.size)
+    prev = 0
+    for v in flat.tolist():
+        d = v - prev
+        prev = v
+        put(((d << 1) ^ (d >> 31)) & 0xFFFFFFFF)
+    return bytes(out)
+
+
+def _py_decompress(blob: bytes) -> np.ndarray:
+    pos = 0
+
+    def get() -> int:
+        nonlocal pos
+        shift = 0
+        v = 0
+        while True:
+            b = blob[pos]
+            pos += 1
+            v |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return v
+            shift += 7
+
+    n = get()
+    out = np.empty(n, dtype=np.int32)
+    prev = 0
+    for i in range(n):
+        z = get()
+        d = (z >> 1) ^ -(z & 1)
+        prev = (prev + d) & 0xFFFFFFFF
+        if prev >= 0x80000000:
+            prev -= 0x100000000
+        out[i] = prev
+    return out

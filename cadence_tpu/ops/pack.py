@@ -91,13 +91,23 @@ class PackedHistories:
     side: List[WorkflowSideTable]
     caps: S.Capacities
     epoch_s: int = 0
+    # concatenated valid rows ([sum(lengths), EV_N]) kept for the native
+    # sidecar's fused pad+layout path; None when constructed externally
+    rows_concat: Optional[np.ndarray] = None
 
     @property
     def batch(self) -> int:
         return self.events.shape[0]
 
     def time_major(self) -> np.ndarray:
-        """[T, B, EV_N] — the layout lax.scan consumes."""
+        """[T, B, EV_N] — the layout lax.scan consumes. Uses the C++
+        sidecar's fused scatter when the packed rows are available."""
+        if self.rows_concat is not None:
+            from cadence_tpu.native import scatter_time_major
+
+            return scatter_time_major(
+                self.rows_concat, self.lengths, self.caps.max_events
+            )
         return np.ascontiguousarray(np.transpose(self.events, (1, 0, 2)))
 
 
@@ -450,8 +460,6 @@ def pack_histories(
     caps = caps or S.Capacities()
     b = len(histories)
     bp = max(pad_batch_to or b, b)
-    events = np.full((bp, caps.max_events, S.EV_N), 0, dtype=np.int32)
-    events[:, :, S.EV_TYPE] = -1  # padding sentinel
     lengths = np.zeros((bp,), dtype=np.int32)
     side: List[WorkflowSideTable] = []
     first_ts = [
@@ -460,18 +468,34 @@ def pack_histories(
         if batches and batches[0]
     ]
     epoch_s = min(first_ts) // SECONDS if first_ts else 0
+    per_wf: List[np.ndarray] = []
     for idx, (wf_id, run_id, batches) in enumerate(histories):
         arr, st = pack_workflow(
             batches, caps, workflow_id=wf_id, run_id=run_id, epoch_s=epoch_s
         )
-        n = arr.shape[0]
-        events[idx, :n, :] = arr
-        lengths[idx] = n
+        lengths[idx] = arr.shape[0]
         side.append(st)
+        per_wf.append(arr)
     for _ in range(bp - b):
         side.append(WorkflowSideTable())
+    rows_concat = (
+        np.concatenate(per_wf, axis=0)
+        if per_wf
+        else np.zeros((0, S.EV_N), dtype=np.int32)
+    )
+    # one fused pad+layout pass (C++ sidecar when available) instead of
+    # a per-workflow fill loop
+    from cadence_tpu.native import scatter_batch_major
+
+    events = scatter_batch_major(rows_concat, lengths, caps.max_events)
+    # rows_concat is the replay source of truth (time_major reads it);
+    # freeze the derived tensor so divergence-by-mutation is an error,
+    # not a silent mismatch
+    events.flags.writeable = False
+    rows_concat.flags.writeable = False
     return PackedHistories(
-        events=events, lengths=lengths, side=side, caps=caps, epoch_s=epoch_s
+        events=events, lengths=lengths, side=side, caps=caps,
+        epoch_s=epoch_s, rows_concat=rows_concat,
     )
 
 

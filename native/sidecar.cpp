@@ -1,0 +1,168 @@
+// Packing/transport sidecar.
+//
+// The north-star architecture (SURVEY.md §2.8) calls for a native
+// sidecar between the host control plane and the device path: it owns
+// the numeric, bandwidth-bound steps of packing — scattering ragged
+// per-workflow event rows into the dense time-major [T, B, E] tensor
+// the replay scan consumes — plus the transport codec that ships those
+// tensors across hosts (varint+zigzag delta compression; event tensors
+// are small-valued and monotone, so this typically shrinks them 4-8x
+// before they hit DCN).
+//
+// Exposed via a C ABI for ctypes (pybind11 is not available in this
+// image). All buffers are caller-allocated numpy arrays.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// -- scatter: ragged rows -> dense time-major ---------------------------
+//
+// rows:    [total_events, ev_n] int32, workflows concatenated in order
+// lengths: [batch] int64, events per workflow (sum == total_events)
+// out:     [max_events, batch, ev_n] int32, fully overwritten:
+//          valid slots get their row; padding gets type_pad at column 0
+//          (EV_TYPE) and zeros elsewhere.
+void ct_scatter_time_major(const int32_t* rows, const int64_t* lengths,
+                           int64_t batch, int64_t ev_n, int64_t max_events,
+                           int32_t type_pad, int32_t* out) {
+    // one sequential pass over the destination (the big buffer):
+    // per (t, b) either copy the event row or write the padding row
+    const int64_t plane = batch * ev_n;
+    // per-workflow source cursors
+    const int32_t** srcs = new const int32_t*[batch];
+    {
+        const int32_t* p = rows;
+        for (int64_t b = 0; b < batch; ++b) {
+            srcs[b] = p;
+            p += lengths[b] * ev_n;
+        }
+    }
+    // rows are short (EV_N ~ a dozen int32) — an open-coded copy beats
+    // a memcpy call per row
+    for (int64_t t = 0; t < max_events; ++t) {
+        int32_t* dst = out + t * plane;
+        for (int64_t b = 0; b < batch; ++b, dst += ev_n) {
+            if (t < lengths[b]) {
+                const int32_t* s = srcs[b] + t * ev_n;
+                for (int64_t k = 0; k < ev_n; ++k) dst[k] = s[k];
+            } else {
+                dst[0] = type_pad;  // EV_TYPE padding sentinel
+                for (int64_t k = 1; k < ev_n; ++k) dst[k] = 0;
+            }
+        }
+    }
+    delete[] srcs;
+}
+
+// batch-major variant: out [batch, max_events, ev_n]
+void ct_scatter_batch_major(const int32_t* rows, const int64_t* lengths,
+                            int64_t batch, int64_t ev_n, int64_t max_events,
+                            int32_t type_pad, int32_t* out) {
+    const int64_t plane = max_events * ev_n;
+    std::memset(out, 0, sizeof(int32_t) * batch * plane);
+    for (int64_t b = 0; b < batch; ++b) {
+        int32_t* wf = out + b * plane;
+        for (int64_t t = 0; t < max_events; ++t) {
+            wf[t * ev_n] = type_pad;
+        }
+    }
+    const int32_t* src = rows;
+    for (int64_t b = 0; b < batch; ++b) {
+        const int64_t n = lengths[b];
+        std::memcpy(out + b * plane, src, sizeof(int32_t) * n * ev_n);
+        src += n * ev_n;
+    }
+}
+
+// -- hashing ------------------------------------------------------------
+//
+// FNV-1a 32-bit over each string, masked to 31 bits (the packer's
+// hash31 for id -> integer-slot-key pre-hashing).
+void ct_fnv1a32_batch(const char* data, const int64_t* offsets,
+                      int64_t n, uint32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t h = 2166136261u;
+        for (int64_t p = offsets[i]; p < offsets[i + 1]; ++p) {
+            h ^= (uint8_t)data[p];
+            h *= 16777619u;
+        }
+        out[i] = h & 0x7fffffffu;
+    }
+}
+
+// -- transport codec ----------------------------------------------------
+//
+// zigzag + varint over int32 deltas (consecutive values in packed event
+// tensors are strongly correlated). Encoded layout: varint(count) then
+// count varints of zigzag(delta).
+
+static inline uint32_t zigzag32(int32_t v) {
+    return ((uint32_t)v << 1) ^ (uint32_t)(v >> 31);
+}
+
+static inline int32_t unzigzag32(uint32_t v) {
+    return (int32_t)(v >> 1) ^ -(int32_t)(v & 1);
+}
+
+static inline uint8_t* put_varint(uint8_t* p, uint32_t v) {
+    while (v >= 0x80) {
+        *p++ = (uint8_t)(v | 0x80);
+        v >>= 7;
+    }
+    *p++ = (uint8_t)v;
+    return p;
+}
+
+static inline const uint8_t* get_varint(const uint8_t* p, uint32_t* v) {
+    uint32_t out = 0;
+    int shift = 0;
+    while (true) {
+        uint8_t b = *p++;
+        out |= (uint32_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    *v = out;
+    return p;
+}
+
+// Worst case: 5 bytes per value + 5-byte header.
+int64_t ct_compress_bound(int64_t n) { return 5 * n + 5; }
+
+// returns encoded byte count
+int64_t ct_tensor_compress(const int32_t* data, int64_t n, uint8_t* out) {
+    uint8_t* p = put_varint(out, (uint32_t)n);
+    int32_t prev = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        p = put_varint(p, zigzag32(data[i] - prev));
+        prev = data[i];
+    }
+    return (int64_t)(p - out);
+}
+
+// returns decoded element count (caller sized `out` via the header)
+int64_t ct_tensor_decompress(const uint8_t* blob, int64_t blob_len,
+                             int32_t* out) {
+    (void)blob_len;
+    uint32_t n;
+    const uint8_t* p = get_varint(blob, &n);
+    int32_t prev = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        uint32_t z;
+        p = get_varint(p, &z);
+        prev += unzigzag32(z);
+        out[i] = prev;
+    }
+    return (int64_t)n;
+}
+
+// peek the element count without decoding
+int64_t ct_tensor_peek_count(const uint8_t* blob) {
+    uint32_t n;
+    get_varint(blob, &n);
+    return (int64_t)n;
+}
+
+}  // extern "C"

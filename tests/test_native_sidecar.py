@@ -1,0 +1,120 @@
+"""C++ packing/transport sidecar: build, differential-vs-numpy, and
+integration with the packer's time-major path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cadence_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    loaded = native._load()
+    if loaded is None:
+        pytest.skip("g++ unavailable: native sidecar not built")
+    return loaded
+
+
+def _ragged(seed=5, batch=7, ev_n=6, max_events=12):
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(0, max_events + 1, size=batch)
+    rows = rng.integers(
+        -1000, 1000, size=(int(lengths.sum()), ev_n)
+    ).astype(np.int32)
+    return rows, lengths, max_events
+
+
+class TestScatter:
+    def test_time_major_matches_python(self, lib):
+        rows, lengths, T = _ragged()
+        nat = native.scatter_time_major(rows, lengths, T)
+        ref = native.scatter_time_major(rows, lengths, T, force_python=True)
+        np.testing.assert_array_equal(nat, ref)
+        # padding sentinel in the EV_TYPE column
+        b0 = int(lengths[0])
+        if b0 < T:
+            assert nat[b0, 0, 0] == -1
+            assert (nat[b0, 0, 1:] == 0).all()
+
+    def test_batch_major_matches_python(self, lib):
+        rows, lengths, T = _ragged(seed=9)
+        nat = native.scatter_batch_major(rows, lengths, T)
+        ref = native.scatter_batch_major(rows, lengths, T, force_python=True)
+        np.testing.assert_array_equal(nat, ref)
+
+    def test_empty_batch(self, lib):
+        out = native.scatter_time_major(
+            np.zeros((0, 4), dtype=np.int32), np.zeros(3, dtype=np.int64), 5
+        )
+        assert out.shape == (5, 3, 4)
+        assert (out[:, :, 0] == -1).all()
+
+
+class TestHash:
+    def test_matches_host_hash31(self, lib):
+        from cadence_tpu.utils.hashing import hash31
+
+        strings = ["", "a", "activity-1", "∂omega", "x" * 500]
+        nat = native.fnv1a32_batch(strings)
+        assert list(nat) == [hash31(s) for s in strings]
+
+
+class TestTransportCodec:
+    def test_roundtrip(self, lib):
+        rng = np.random.default_rng(3)
+        t = rng.integers(-(2**31), 2**31 - 1, size=(17, 5)).astype(np.int32)
+        blob, shape = native.tensor_compress(t)
+        back = native.tensor_decompress(blob, shape)
+        np.testing.assert_array_equal(t, back)
+
+    def test_python_native_interop(self, lib):
+        t = np.arange(-50, 450, dtype=np.int32).reshape(10, 50)
+        blob_n, shape = native.tensor_compress(t)
+        blob_p, _ = native.tensor_compress(t, force_python=True)
+        assert blob_n == blob_p
+        np.testing.assert_array_equal(
+            native.tensor_decompress(blob_n, shape, force_python=True), t
+        )
+
+    def test_compresses_event_tensors(self, lib):
+        """Real packed tensors must shrink well below raw int32."""
+        from cadence_tpu.ops.pack import pack_histories
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        fuzzer = HistoryFuzzer(seed=41)
+        packed = pack_histories(
+            [
+                (f"w{i}", f"r{i}", fuzzer.generate(target_events=30))
+                for i in range(8)
+            ]
+        )
+        tm = packed.time_major()
+        blob, shape = native.tensor_compress(tm)
+        ratio = tm.nbytes / max(1, len(blob))
+        assert ratio > 3.0, f"only {ratio:.1f}x on a packed event tensor"
+        np.testing.assert_array_equal(
+            native.tensor_decompress(blob, shape), tm
+        )
+
+
+class TestPackerIntegration:
+    def test_time_major_native_equals_transpose(self, lib):
+        from cadence_tpu.ops.pack import pack_histories
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        fuzzer = HistoryFuzzer(seed=13)
+        packed = pack_histories(
+            [
+                (f"w{i}", f"r{i}", fuzzer.generate(target_events=25))
+                for i in range(5)
+            ],
+            pad_batch_to=8,
+        )
+        via_native = packed.time_major()
+        via_transpose = np.ascontiguousarray(
+            np.transpose(packed.events, (1, 0, 2))
+        )
+        np.testing.assert_array_equal(via_native, via_transpose)
