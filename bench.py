@@ -47,7 +47,7 @@ def main() -> None:
     on_cpu = jax.default_backend() == "cpu"
     depth = 1000
     n_unique = 32
-    batch = 512 if on_cpu else 4096
+    batch = 512 if on_cpu else 8192
     iters = 2 if on_cpu else 8
 
     caps = S.Capacities(max_events=1024)
