@@ -106,6 +106,13 @@ class ClusterMetadata:
         info = self._info.get(cluster)
         if info is None:
             raise ValueError(f"unknown cluster {cluster!r}")
+        # Sentinel inputs (e.g. EMPTY_VERSION = -24) land in cycle 0,
+        # i.e. the cluster's initial failover version. This deliberately
+        # deviates from the reference (whose truncating arithmetic can
+        # return a negative version like -19 for -24, which no cluster
+        # owns): a negative version means "no failover has happened", so
+        # the next version owned by `cluster` is its cycle-0 version.
+        current_version = max(current_version, 0)
         failed_version = info.initial_failover_version + (
             current_version // self._increment
         ) * self._increment

@@ -82,7 +82,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ct_tensor_compress.restype = ctypes.c_int64
         lib.ct_tensor_decompress.argtypes = [u8p, ctypes.c_int64, i32p]
         lib.ct_tensor_decompress.restype = ctypes.c_int64
-        lib.ct_tensor_peek_count.argtypes = [u8p]
+        lib.ct_tensor_peek_count.argtypes = [u8p, ctypes.c_int64]
         lib.ct_tensor_peek_count.restype = ctypes.c_int64
         _lib = lib
         HAVE_NATIVE = True
@@ -100,6 +100,30 @@ def _i64p(a: np.ndarray):
 # -- scatter ---------------------------------------------------------------
 
 
+def _check_scatter_args(
+    rows: np.ndarray, lengths: np.ndarray, max_events: int
+) -> None:
+    """Bounds-check the public scatter API before handing buffers to C.
+
+    The native scatter trusts its inputs (it clamps per-workflow copies
+    to ``max_events`` but cannot detect a lengths/rows mismatch), so
+    reject anything inconsistent here, matching the numpy fallback's
+    broadcast errors.
+    """
+    if lengths.size and int(lengths.min()) < 0:
+        raise ValueError("scatter: negative workflow length")
+    if lengths.size and int(lengths.max()) > max_events:
+        raise ValueError(
+            f"scatter: workflow length {int(lengths.max())} exceeds "
+            f"max_events={max_events}"
+        )
+    n_rows = rows.shape[0] if rows.ndim == 2 else 0
+    if int(lengths.sum()) != n_rows:
+        raise ValueError(
+            f"scatter: sum(lengths)={int(lengths.sum())} != rows={n_rows}"
+        )
+
+
 def scatter_time_major(
     rows: np.ndarray, lengths: np.ndarray, max_events: int,
     type_pad: int = -1, force_python: bool = False,
@@ -107,6 +131,7 @@ def scatter_time_major(
     """[sum(lengths), E] rows + [B] lengths → [T, B, E] dense tensor."""
     rows = np.ascontiguousarray(rows, dtype=np.int32)
     lengths64 = np.ascontiguousarray(lengths, dtype=np.int64)
+    _check_scatter_args(rows, lengths64, max_events)
     batch = len(lengths64)
     ev_n = rows.shape[1] if rows.ndim == 2 else 0
     lib = None if force_python else _load()
@@ -134,6 +159,7 @@ def scatter_batch_major(
 ) -> np.ndarray:
     rows = np.ascontiguousarray(rows, dtype=np.int32)
     lengths64 = np.ascontiguousarray(lengths, dtype=np.int64)
+    _check_scatter_args(rows, lengths64, max_events)
     batch = len(lengths64)
     ev_n = rows.shape[1] if rows.ndim == 2 else 0
     lib = None if force_python else _load()
@@ -199,18 +225,25 @@ def tensor_compress(
 def tensor_decompress(
     blob: bytes, shape: Tuple[int, ...], force_python: bool = False
 ) -> np.ndarray:
+    expected = int(np.prod(shape)) if shape else 1
     lib = None if force_python else _load()
     if lib is None:
-        return _py_decompress(blob).reshape(shape)
+        return _py_decompress(blob, expected).reshape(shape)
     raw = np.frombuffer(blob, dtype=np.uint8)
-    count = lib.ct_tensor_peek_count(
-        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-    )
+    u8 = raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    count = lib.ct_tensor_peek_count(u8, len(blob))
+    if count < 0 or count != expected:
+        raise ValueError(
+            f"tensor_decompress: corrupt blob (count={count}, "
+            f"expected {expected})"
+        )
     out = np.empty(count, dtype=np.int32)
-    lib.ct_tensor_decompress(
-        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(blob),
-        _i32p(out),
-    )
+    decoded = lib.ct_tensor_decompress(u8, len(blob), _i32p(out))
+    if decoded != count:
+        raise ValueError(
+            f"tensor_decompress: truncated blob (decoded {decoded} of "
+            f"{count})"
+        )
     return out.reshape(shape)
 
 
@@ -226,13 +259,17 @@ def _py_compress(flat: np.ndarray) -> bytes:
     put(flat.size)
     prev = 0
     for v in flat.tolist():
-        d = v - prev
+        # wrap the delta to int32 first: Python ints are unbounded, so
+        # a raw (d >> 31) sign probe is wrong for |d| >= 2^31 (e.g. a
+        # -1 pad followed by a 2^31-1 hash31 slot key) and would break
+        # encode/decode symmetry with the native codec
+        d = ((v - prev + 0x80000000) & 0xFFFFFFFF) - 0x80000000
         prev = v
         put(((d << 1) ^ (d >> 31)) & 0xFFFFFFFF)
     return bytes(out)
 
 
-def _py_decompress(blob: bytes) -> np.ndarray:
+def _py_decompress(blob: bytes, expected: Optional[int] = None) -> np.ndarray:
     pos = 0
 
     def get() -> int:
@@ -240,14 +277,23 @@ def _py_decompress(blob: bytes) -> np.ndarray:
         shift = 0
         v = 0
         while True:
+            if pos >= len(blob) or shift > 28:
+                raise ValueError("tensor_decompress: corrupt blob")
             b = blob[pos]
             pos += 1
             v |= (b & 0x7F) << shift
             if not (b & 0x80):
-                return v
+                return v & 0xFFFFFFFF
             shift += 7
 
     n = get()
+    if expected is not None and n != expected:
+        # validate the header BEFORE allocating: a forged count would
+        # otherwise trigger a giant np.empty from a few corrupt bytes
+        raise ValueError(
+            f"tensor_decompress: corrupt blob (count={n}, "
+            f"expected {expected})"
+        )
     out = np.empty(n, dtype=np.int32)
     prev = 0
     for i in range(n):

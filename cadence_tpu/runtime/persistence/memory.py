@@ -43,6 +43,7 @@ from .records import (
 )
 
 _COMPLETED = 2  # WorkflowState.Completed
+_ZOMBIE = 3  # WorkflowState.Zombie
 
 
 class MemoryShardManager(I.ShardManager):
@@ -168,6 +169,25 @@ class MemoryExecutionManager(I.ExecutionManager):
                     raise ConditionFailedError("continue-as-new current mismatch")
             elif mode == CreateWorkflowMode.ZOMBIE:
                 pass
+            elif mode == CreateWorkflowMode.SUPPRESS_CURRENT:
+                if cur is None or cur.run_id != prev_run_id:
+                    raise ConditionFailedError(
+                        "suppress-current run mismatch: "
+                        f"{cur.run_id if cur else None} != {prev_run_id}"
+                    )
+                # zombify the stale run's stored record so nothing that
+                # reloads it treats it as a live current run
+                old_key = (
+                    shard_id, snapshot.domain_id, snapshot.workflow_id,
+                    cur.run_id,
+                )
+                old = self._executions.get(old_key)
+                if old is not None:
+                    snap, next_eid, lwv = old
+                    ex = snap.get("execution_info")
+                    if isinstance(ex, dict):
+                        ex["state"] = _ZOMBIE
+                    self._executions[old_key] = (snap, next_eid, lwv)
             else:
                 raise ValueError(f"unknown create mode {mode}")
 

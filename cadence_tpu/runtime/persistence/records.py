@@ -51,6 +51,11 @@ class CreateWorkflowMode:
     WORKFLOW_ID_REUSE = 1
     CONTINUE_AS_NEW = 2
     ZOMBIE = 3  # replication-created, not the current run
+    # replication-created with a NEWER version than a still-running
+    # current run: the stale run is zombified and the incoming run takes
+    # the current record (ref nDCTransactionPolicySuppressCurrentAndCreateAsCurrent,
+    # nDCTransactionMgrForNewWorkflow.go)
+    SUPPRESS_CURRENT = 4
 
 
 @dataclasses.dataclass

@@ -256,6 +256,30 @@ class SqliteExecutionManager(I.ExecutionManager):
                 raise ConditionFailedError("continue-as-new current mismatch")
         elif mode == CreateWorkflowMode.ZOMBIE:
             pass
+        elif mode == CreateWorkflowMode.SUPPRESS_CURRENT:
+            if not cur_row or cur_row[0] != prev_run_id:
+                raise ConditionFailedError(
+                    "suppress-current run mismatch: "
+                    f"{cur_row[0] if cur_row else None} != {prev_run_id}"
+                )
+            # zombify the stale run's stored record (WorkflowState.Zombie=3)
+            old = c.execute(
+                "SELECT snapshot FROM executions WHERE shard_id=? AND "
+                "domain_id=? AND workflow_id=? AND run_id=?",
+                (shard_id, snapshot.domain_id, snapshot.workflow_id,
+                 cur_row[0]),
+            ).fetchone()
+            if old:
+                snap = json.loads(old[0])
+                ex = snap.get("execution_info")
+                if isinstance(ex, dict):
+                    ex["state"] = 3
+                c.execute(
+                    "UPDATE executions SET snapshot=? WHERE shard_id=? AND "
+                    "domain_id=? AND workflow_id=? AND run_id=?",
+                    (json.dumps(snap), shard_id, snapshot.domain_id,
+                     snapshot.workflow_id, cur_row[0]),
+                )
         else:
             raise ValueError(f"unknown create mode {mode}")
         state, close_status = self._exec_state(snapshot.snapshot)

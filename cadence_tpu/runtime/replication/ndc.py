@@ -143,6 +143,12 @@ class NDCHistoryReplicator:
             self.shard.shard_id, self.shard.range_id, mode, snapshot,
             prev_run_id=prev_run_id,
         )
+        if mode == CreateWorkflowMode.SUPPRESS_CURRENT:
+            # the store zombified the stale run's persisted record; evict
+            # its cached context so a late replication task for that run
+            # reloads the zombie state instead of resurrecting the cached
+            # Running mutable state on its next write
+            self.cache.evict(task.domain_id, task.workflow_id, prev_run_id)
         ctx._ms = ms
         ctx._condition = ms.next_event_id
         self._notify(sb)
@@ -159,6 +165,16 @@ class NDCHistoryReplicator:
             WorkflowState.Completed
         ):
             return CreateWorkflowMode.WORKFLOW_ID_REUSE, cur.run_id
+        if task.version > cur.last_write_version:
+            # incoming run was written by a NEWER failover version than
+            # the still-running current run: after a failover the new
+            # active cluster's run must take primacy — suppress the
+            # stale run and create the incoming one as current (ref
+            # nDCTransactionMgrForNewWorkflow.go
+            # SuppressCurrentAndCreateAsCurrent); a plain ZOMBIE create
+            # would leave workflow_id lookups resolving to the stale
+            # run forever
+            return CreateWorkflowMode.SUPPRESS_CURRENT, cur.run_id
         # a running current run with a version >= ours keeps primacy
         return CreateWorkflowMode.ZOMBIE, ""
 

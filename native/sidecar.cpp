@@ -71,7 +71,13 @@ void ct_scatter_batch_major(const int32_t* rows, const int64_t* lengths,
     const int32_t* src = rows;
     for (int64_t b = 0; b < batch; ++b) {
         const int64_t n = lengths[b];
-        std::memcpy(out + b * plane, src, sizeof(int32_t) * n * ev_n);
+        // clamp to the plane: an oversized workflow copies its first
+        // max_events rows (mirrors the time-major loop bound) instead
+        // of overrunning the destination
+        const int64_t n_copy = n < max_events ? n : max_events;
+        if (n_copy > 0) {
+            std::memcpy(out + b * plane, src, sizeof(int32_t) * n_copy * ev_n);
+        }
         src += n * ev_n;
     }
 }
@@ -115,10 +121,15 @@ static inline uint8_t* put_varint(uint8_t* p, uint32_t v) {
     return p;
 }
 
-static inline const uint8_t* get_varint(const uint8_t* p, uint32_t* v) {
+// bounded read: returns the advanced cursor, or nullptr on truncation
+// or an overlong (>5 byte) varint — corrupt transport input is a
+// realistic failure mode for the DCN codec.
+static inline const uint8_t* get_varint(const uint8_t* p, const uint8_t* end,
+                                        uint32_t* v) {
     uint32_t out = 0;
     int shift = 0;
     while (true) {
+        if (p >= end || shift > 28) return nullptr;
         uint8_t b = *p++;
         out |= (uint32_t)(b & 0x7f) << shift;
         if (!(b & 0x80)) break;
@@ -142,26 +153,29 @@ int64_t ct_tensor_compress(const int32_t* data, int64_t n, uint8_t* out) {
     return (int64_t)(p - out);
 }
 
-// returns decoded element count (caller sized `out` via the header)
+// returns decoded element count (caller sized `out` via the header),
+// or -1 on a truncated / malformed blob
 int64_t ct_tensor_decompress(const uint8_t* blob, int64_t blob_len,
                              int32_t* out) {
-    (void)blob_len;
+    const uint8_t* end = blob + blob_len;
     uint32_t n;
-    const uint8_t* p = get_varint(blob, &n);
+    const uint8_t* p = get_varint(blob, end, &n);
+    if (p == nullptr) return -1;
     int32_t prev = 0;
     for (uint32_t i = 0; i < n; ++i) {
         uint32_t z;
-        p = get_varint(p, &z);
-        prev += unzigzag32(z);
+        p = get_varint(p, end, &z);
+        if (p == nullptr) return -1;
+        prev = (int32_t)((uint32_t)prev + (uint32_t)unzigzag32(z));
         out[i] = prev;
     }
     return (int64_t)n;
 }
 
-// peek the element count without decoding
-int64_t ct_tensor_peek_count(const uint8_t* blob) {
+// peek the element count without decoding; -1 on malformed header
+int64_t ct_tensor_peek_count(const uint8_t* blob, int64_t blob_len) {
     uint32_t n;
-    get_varint(blob, &n);
+    if (get_varint(blob, blob + blob_len, &n) == nullptr) return -1;
     return (int64_t)n;
 }
 

@@ -37,6 +37,17 @@ class TestClusterMetadata:
         assert meta.next_failover_version("standby", 11) == 12
         assert meta.next_failover_version("standby", 12) == 12
 
+    def test_next_failover_version_sentinel_input(self, meta):
+        # EMPTY_VERSION (-24) and other negatives land in cycle 0 (the
+        # cluster's initial version) — a deliberate deviation from the
+        # reference, whose truncating arithmetic can return a negative
+        # version that no cluster owns
+        from cadence_tpu.cluster.metadata import EMPTY_VERSION
+
+        assert meta.next_failover_version("active", EMPTY_VERSION) == 1
+        assert meta.next_failover_version("standby", EMPTY_VERSION) == 2
+        assert meta.next_failover_version("active", -1) == 1
+
     def test_version_to_cluster(self, meta):
         assert meta.cluster_name_for_failover_version(1) == "active"
         assert meta.cluster_name_for_failover_version(21) == "active"

@@ -52,6 +52,22 @@ class TestScatter:
         assert out.shape == (5, 3, 4)
         assert (out[:, :, 0] == -1).all()
 
+    def test_rejects_inconsistent_lengths(self, lib):
+        """Public API bounds-checks before buffers reach the native code."""
+        rows = np.zeros((6, 4), dtype=np.int32)
+        good = np.array([2, 4], dtype=np.int64)
+        for fn in (native.scatter_time_major, native.scatter_batch_major):
+            for force in (False, True):
+                fn(rows, good, 5, force_python=force)  # sanity: accepted
+                with pytest.raises(ValueError):  # length > max_events
+                    fn(rows, good, 3, force_python=force)
+                with pytest.raises(ValueError):  # negative length
+                    fn(rows, np.array([-1, 7], dtype=np.int64), 8,
+                       force_python=force)
+                with pytest.raises(ValueError):  # sum(lengths) != rows
+                    fn(rows, np.array([2, 2], dtype=np.int64), 5,
+                       force_python=force)
+
 
 class TestHash:
     def test_matches_host_hash31(self, lib):
@@ -78,6 +94,36 @@ class TestTransportCodec:
         np.testing.assert_array_equal(
             native.tensor_decompress(blob_n, shape, force_python=True), t
         )
+
+    def test_wide_deltas_roundtrip_both_paths(self, lib):
+        """Deltas with |d| >= 2^31: a -1 pad followed by a 2^31-1 hash31
+        slot key is a real packed-tensor pattern; the python encoder's
+        zigzag must wrap to int32 to stay symmetric with the native one."""
+        t = np.array(
+            [-1, 2**31 - 1, 0, -(2**31), 2**31 - 1, -1], dtype=np.int32
+        )
+        for force_c in (False, True):
+            blob, shape = native.tensor_compress(t, force_python=force_c)
+            for force_d in (False, True):
+                back = native.tensor_decompress(
+                    blob, shape, force_python=force_d
+                )
+                np.testing.assert_array_equal(t, back)
+
+    def test_truncated_and_corrupt_blobs_raise(self, lib):
+        t = np.arange(100, dtype=np.int32)
+        blob, shape = native.tensor_compress(t)
+        for force in (False, True):
+            with pytest.raises(ValueError):
+                native.tensor_decompress(blob[: len(blob) // 2], shape,
+                                         force_python=force)
+            # overlong varint: 6 continuation bytes
+            with pytest.raises(ValueError):
+                native.tensor_decompress(b"\xff" * 10, (1,),
+                                         force_python=force)
+            # count mismatch vs declared shape
+            with pytest.raises(ValueError):
+                native.tensor_decompress(blob, (3, 7), force_python=force)
 
     def test_compresses_event_tensors(self, lib):
         """Real packed tensors must shrink well below raw int32."""

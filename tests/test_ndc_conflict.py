@@ -279,3 +279,78 @@ def test_signal_on_stale_branch_reapplied_when_active(box):
             f"signal lost: history={names} buffered={buffered}"
         )
         _time.sleep(0.05)
+
+
+def test_newer_version_new_run_suppresses_stale_current(box):
+    """Failover racing a new run: a replicated NEW run with a newer
+    failover version arrives while the stale current run (lower version)
+    is still running. The incoming run must take the current record —
+    SuppressCurrentAndCreateAsCurrent (nDCTransactionMgrForNewWorkflow.go)
+    — not be parked as a zombie that never becomes visible."""
+    from cadence_tpu.core.enums import WorkflowState
+
+    wf = "wf-suppress"
+    run_a, run_b = str(uuid.uuid4()), str(uuid.uuid4())
+    _seed(box, wf, run_a)  # run A: running at ACTIVE_V
+
+    ex = box.persistence.execution
+    assert ex.get_current_execution(0, box.domain_id, wf).run_id == run_a
+
+    b1, b2 = _base_batches(v=STANDBY_V)
+    box.engine.replicate_events_v2(
+        _task(box, wf, run_b,
+              [{"event_id": 2, "version": STANDBY_V}], b1, task_id=10)
+    )
+
+    cur = ex.get_current_execution(0, box.domain_id, wf)
+    assert cur.run_id == run_b, "newer-version run must become current"
+    # the stale run's record is zombified, not left as a live run
+    stale = ex.get_workflow_execution(0, box.domain_id, wf, run_a)
+    assert stale.snapshot["execution_info"]["state"] == int(
+        WorkflowState.Zombie
+    )
+    # and the new current run keeps replicating normally
+    box.engine.replicate_events_v2(
+        _task(box, wf, run_b,
+              [{"event_id": 3, "version": STANDBY_V}], b2, task_id=11)
+    )
+    events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run_b)
+    assert [e.event_id for e in events] == [1, 2, 3]
+
+    # a LATE replication task for the stale run must not resurrect it:
+    # its cached context was evicted at suppression, so the append
+    # reloads (and re-persists) the zombie state
+    _, a2 = _base_batches()
+    box.engine.replicate_events_v2(
+        _task(box, wf, run_a,
+              [{"event_id": 3, "version": ACTIVE_V}], a2, task_id=12)
+    )
+    assert ex.get_current_execution(0, box.domain_id, wf).run_id == run_b
+    stale = ex.get_workflow_execution(0, box.domain_id, wf, run_a)
+    assert stale.snapshot["execution_info"]["state"] == int(
+        WorkflowState.Zombie
+    ), "late replication resurrected the suppressed run"
+
+
+def test_older_version_new_run_stays_zombie(box):
+    """The mirror case: a replicated new run with an OLDER version than
+    the running current run must NOT steal the current record."""
+    wf = "wf-zombie"
+    run_a, run_b = str(uuid.uuid4()), str(uuid.uuid4())
+    # seed run A at STANDBY_V (newer)
+    b1, b2 = _base_batches(v=STANDBY_V)
+    box.engine.replicate_events_v2(
+        _task(box, wf, run_a,
+              [{"event_id": 2, "version": STANDBY_V}], b1, task_id=1)
+    )
+    ex = box.persistence.execution
+    assert ex.get_current_execution(0, box.domain_id, wf).run_id == run_a
+
+    a1, _ = _base_batches(v=ACTIVE_V)
+    box.engine.replicate_events_v2(
+        _task(box, wf, run_b,
+              [{"event_id": 2, "version": ACTIVE_V}], a1, task_id=2)
+    )
+    assert ex.get_current_execution(0, box.domain_id, wf).run_id == run_a
+    # the zombie run exists but is not current
+    assert ex.get_workflow_execution(0, box.domain_id, wf, run_b)
