@@ -84,6 +84,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ct_tensor_decompress.restype = ctypes.c_int64
         lib.ct_tensor_peek_count.argtypes = [u8p, ctypes.c_int64]
         lib.ct_tensor_peek_count.restype = ctypes.c_int64
+        lib.ct_replay_sequential.argtypes = (
+            [i32p, i64p] + [ctypes.c_int64] * 8 + [i32p] * 8
+        )
         _lib = lib
         HAVE_NATIVE = True
         return lib
@@ -304,3 +307,42 @@ def _py_decompress(blob: bytes, expected: Optional[int] = None) -> np.ndarray:
             prev -= 0x100000000
         out[i] = prev
     return out
+
+
+# -- sequential replayer (compiled-host baseline) --------------------------
+
+
+def replay_sequential(packed, caps=None):
+    """Replay packed histories with the C++ sequential loop.
+
+    The compiled-host baseline for bench.py: identical transition
+    semantics to the TPU kernel (ops/replay.py) applied one workflow,
+    one event at a time — the shape of the reference's Go
+    stateBuilder.applyEvents loop (service/history/stateBuilder.go:112-613).
+    Returns StateTensors (numpy). Requires the native sidecar; raises
+    RuntimeError when g++ is unavailable (the baseline must be compiled
+    code, never interpreted Python).
+    """
+    from cadence_tpu.ops import schema as S
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native sidecar unavailable: no compiled baseline")
+    caps = caps or packed.caps
+    events = np.ascontiguousarray(packed.events, dtype=np.int32)  # [B,T,E]
+    batch, T, ev_n = events.shape
+    if ev_n != S.EV_N:
+        raise ValueError(f"event width {ev_n} != schema EV_N {S.EV_N}")
+    lengths = np.ascontiguousarray(packed.lengths, dtype=np.int64)
+    state = S.empty_state(batch, caps)
+    lib.ct_replay_sequential(
+        _i32p(events), _i64p(lengths), batch, T,
+        caps.max_activities, caps.max_timers, caps.max_children,
+        caps.max_request_cancels, caps.max_signals_ext,
+        caps.max_version_items,
+        _i32p(state.exec_info), _i32p(state.activities),
+        _i32p(state.timers), _i32p(state.children),
+        _i32p(state.cancels), _i32p(state.signals),
+        _i32p(state.vh_items), _i32p(state.vh_len),
+    )
+    return state

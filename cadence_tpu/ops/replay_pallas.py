@@ -1,0 +1,626 @@
+"""Pallas TPU replay kernel — VMEM-resident state scan.
+
+Why this exists: the XLA ``lax.scan`` kernel (ops/replay.py) round-trips
+the full state carry through HBM several times per step (measured
+~160us/step at B=8192 on v5e — ~10x the single-carry HBM cost), because
+the step body compiles to multiple fusions. This kernel keeps the
+entire mutable state of a batch tile resident in VMEM for the whole
+scan and streams only event blocks from HBM, eliminating the carry
+traffic altogether.
+
+Design:
+
+- **Row layout**: all state tensors of a batch tile are packed into one
+  int32 ``[R, BT]`` matrix — batch is the lane (minor) dimension, so
+  every row update is a fully-utilized 128-lane VPU op. R enumerates
+  exec-info columns, version-history slots, then the flattened slot
+  tables (see RowMap).
+- **Grid** ``(B/BT, T/TB)`` with time as the inner sequential dimension;
+  the output state block's index map ignores t, so Pallas keeps it in
+  VMEM across the whole time axis (accumulator pattern) and flushes it
+  once per batch tile.
+- **Predication**: every event-type group and every slot's update is
+  wrapped in ``@pl.when(jnp.any(mask))`` — a tile only pays for the
+  event types (and slots) actually present at that timestep. Real
+  replication storms are type-homogeneous across lanes at most steps,
+  so this skips most of the transition table most of the time; the
+  worst (fully mixed) case degrades to the branchless cost, never
+  above it.
+
+Semantics are identical to ops/replay.py (the oracle's, i.e. the
+reference's stateBuilder.applyEvents,
+/root/reference/service/history/stateBuilder.go:112-613);
+tests/test_replay_pallas.py asserts bit-for-bit state parity against
+the XLA kernel, which is itself differential-tested against the host
+oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cadence_tpu.core.enums import CloseStatus, EventType as E, TimeoutType, WorkflowState
+from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION
+
+from . import schema as S
+
+
+@dataclasses.dataclass(frozen=True)
+class RowMap:
+    """Static row offsets of each state tensor inside the [R, B] matrix."""
+
+    caps: S.Capacities
+    exec0: int = 0
+
+    @property
+    def vh0(self) -> int:  # vh_items rows: vh0 + i*2 + {0: event_id, 1: version}
+        return self.exec0 + S.X_N
+
+    @property
+    def vhlen(self) -> int:
+        return self.vh0 + 2 * self.caps.max_version_items
+
+    @property
+    def act0(self) -> int:
+        return self.vhlen + 1
+
+    @property
+    def tim0(self) -> int:
+        return self.act0 + self.caps.max_activities * S.AC_N
+
+    @property
+    def chd0(self) -> int:
+        return self.tim0 + self.caps.max_timers * S.TI_N
+
+    @property
+    def rc0(self) -> int:
+        return self.chd0 + self.caps.max_children * S.CH_N
+
+    @property
+    def sg0(self) -> int:
+        return self.rc0 + self.caps.max_request_cancels * S.RC_N
+
+    @property
+    def rows(self) -> int:
+        return self.sg0 + self.caps.max_signals_ext * S.SG_N
+
+    @property
+    def rows_padded(self) -> int:
+        return ((self.rows + 7) // 8) * 8
+
+
+def state_to_rows(state: S.StateTensors, rm: RowMap):
+    """StateTensors -> [R, B] int32 (jnp), batch minor."""
+    b = state.exec_info.shape[0]
+    parts = [
+        jnp.transpose(state.exec_info),                       # [X_N, B]
+        jnp.transpose(state.vh_items.reshape(b, -1)),         # [2V, B]
+        state.vh_len[None, :],                                # [1, B]
+        jnp.transpose(state.activities.reshape(b, -1)),
+        jnp.transpose(state.timers.reshape(b, -1)),
+        jnp.transpose(state.children.reshape(b, -1)),
+        jnp.transpose(state.cancels.reshape(b, -1)),
+        jnp.transpose(state.signals.reshape(b, -1)),
+    ]
+    rows = jnp.concatenate(parts, axis=0).astype(jnp.int32)
+    pad = rm.rows_padded - rm.rows
+    if pad:
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+    return rows
+
+
+def rows_to_state(rows, rm: RowMap) -> S.StateTensors:
+    caps = rm.caps
+    b = rows.shape[1]
+
+    def take(r0, n, shape):
+        return jnp.transpose(rows[r0 : r0 + n]).reshape(shape)
+
+    return S.StateTensors(
+        exec_info=take(rm.exec0, S.X_N, (b, S.X_N)),
+        vh_items=take(rm.vh0, 2 * caps.max_version_items,
+                      (b, caps.max_version_items, 2)),
+        vh_len=rows[rm.vhlen],
+        activities=take(rm.act0, caps.max_activities * S.AC_N,
+                        (b, caps.max_activities, S.AC_N)),
+        timers=take(rm.tim0, caps.max_timers * S.TI_N,
+                    (b, caps.max_timers, S.TI_N)),
+        children=take(rm.chd0, caps.max_children * S.CH_N,
+                      (b, caps.max_children, S.CH_N)),
+        cancels=take(rm.rc0, caps.max_request_cancels * S.RC_N,
+                     (b, caps.max_request_cancels, S.RC_N)),
+        signals=take(rm.sg0, caps.max_signals_ext * S.SG_N,
+                     (b, caps.max_signals_ext, S.SG_N)),
+    )
+
+
+def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
+    """One (batch-tile, time-block) grid step.
+
+    The batch tile is shaped (8, 128) — a native int32 VPU tile — so
+    every row update runs at full sublane x lane utilization (a flat
+    [BT] row would occupy 1 of 8 sublanes).
+
+    presence_ref: [1, TB, 4] SMEM — per-step scalar gates for this
+             tile: words 0-1 are the event-type bitmask (bit e of word
+             e//32 set iff some lane has type e), word 2 is the
+             slot-presence bitmask (bit s%32 set iff some lane's event
+             touches slot s), word 3 is padding. Precomputed in
+             parallel by XLA outside the kernel, so the sequential loop
+             gates each type's (and slot's) block on a SCALAR bit test
+             instead of a cross-lane ``jnp.any`` reduction.
+    ev_ref:  [TB, EV_N, 1, 8, 128] — the time block's events
+    init_ref:[R, 1, 8, 128] — initial state block (only read at t==0)
+    st:      [R, 1, 8, 128] — output state block, VMEM-resident across t
+    """
+    caps = rm.caps
+    t_idx = pl.program_id(1)
+
+    @pl.when(t_idx == 0)
+    def _():
+        st[...] = init_ref[...]
+
+    def rd(r):
+        return st[r, 0]
+
+    def wr(r, mask, val):
+        st[r, 0] = jnp.where(mask, val, st[r, 0])
+
+    def step(i, carry):
+        w0 = presence_ref[0, i, 0]
+        w1 = presence_ref[0, i, 1]
+        w_slot = presence_ref[0, i, 2]
+
+        def present(*types):
+            """Scalar: any lane in this tile has one of these types."""
+            out = None
+            for t in types:
+                t = int(t)
+                bit = ((w0 if t < 32 else w1) >> (t % 32)) & 1
+                out = bit if out is None else out | bit
+            return out != 0
+
+        ev = ev_ref[i]  # [EV_N, 1, 8, 128]
+        et = ev[S.EV_TYPE, 0]
+        valid = et >= 0
+
+        ev_id = ev[S.EV_ID, 0]
+        version = ev[S.EV_VERSION, 0]
+        ts = ev[S.EV_TS, 0]
+        batch_first = ev[S.EV_BATCH_FIRST, 0]
+        slot = ev[S.EV_SLOT, 0]
+        a0, a1 = ev[S.EV_A0, 0], ev[S.EV_A1, 0]
+        a2, a3 = ev[S.EV_A2, 0], ev[S.EV_A3, 0]
+        a4, a5 = ev[S.EV_A4, 0], ev[S.EV_A5, 0]
+        a6, a7 = ev[S.EV_A6, 0], ev[S.EV_A7, 0]
+
+        X = rm.exec0
+
+        def m(*types):
+            out = et == int(types[0])
+            for t in types[1:]:
+                out = out | (et == int(t))
+            return valid & out
+
+        # ---- preamble (stateBuilder.go:134-155)
+        wr(X + S.X_LAST_EVENT_TASK_ID, valid, ev[S.EV_TASK_ID, 0])
+        wr(X + S.X_CUR_VERSION, valid, version)
+        wr(X + S.X_NEXT_EVENT_ID, valid, ev_id + 1)
+        wr(X + S.X_LAST_FIRST_EVENT_ID, valid, batch_first)
+
+        # ---- version-history AddOrUpdateItem
+        cap_v = caps.max_version_items
+        vh_len = rd(rm.vhlen)
+        last_idx = jnp.maximum(vh_len - 1, 0)
+        last_ver = jnp.zeros_like(vh_len)
+        for i_v in range(cap_v):
+            last_ver = jnp.where(last_idx == i_v, rd(rm.vh0 + 2 * i_v + 1),
+                                 last_ver)
+        same = (vh_len > 0) & (last_ver == version)
+        write_idx = jnp.where(same, last_idx,
+                              jnp.minimum(vh_len, cap_v - 1))
+        for i_v in range(cap_v):
+            wmask = valid & (write_idx == i_v)
+            wr(rm.vh0 + 2 * i_v, wmask, ev_id)
+            wr(rm.vh0 + 2 * i_v + 1, wmask, version)
+        wr(rm.vhlen, valid & ~same, vh_len + 1)
+
+        # ---- workflow lifecycle
+        @pl.when(present(E.WorkflowExecutionStarted))
+        def _():
+            m_start = m(E.WorkflowExecutionStarted)
+            wr(X + S.X_STATE, m_start, int(WorkflowState.Created))
+            wr(X + S.X_CLOSE_STATUS, m_start, int(CloseStatus.NONE))
+            wr(X + S.X_LAST_PROCESSED_EVENT, m_start, EMPTY_EVENT_ID)
+            wr(X + S.X_START_TS, m_start, ts)
+            wr(X + S.X_WORKFLOW_TIMEOUT, m_start, a0)
+            wr(X + S.X_DECISION_TIMEOUT_VALUE, m_start, a1)
+            wr(X + S.X_ATTEMPT, m_start, a2)
+            wr(X + S.X_HAS_RETRY_POLICY, m_start, a3)
+            wr(X + S.X_WF_EXPIRATION_TS, m_start, a4)
+            wr(X + S.X_PARENT_INITIATED_ID, m_start, a7)
+            wr(X + S.X_DEC_SCHEDULE_ID, m_start, EMPTY_EVENT_ID)
+            wr(X + S.X_DEC_STARTED_ID, m_start, EMPTY_EVENT_ID)
+            wr(X + S.X_DEC_VERSION, m_start, EMPTY_VERSION)
+            for col in (S.X_DEC_TIMEOUT, S.X_DEC_ATTEMPT,
+                        S.X_DEC_SCHEDULED_TS, S.X_DEC_STARTED_TS,
+                        S.X_DEC_ORIGINAL_SCHEDULED_TS):
+                wr(X + col, m_start, 0)
+
+        @pl.when(present(
+            E.WorkflowExecutionCompleted, E.WorkflowExecutionFailed,
+            E.WorkflowExecutionTimedOut, E.WorkflowExecutionCanceled,
+            E.WorkflowExecutionTerminated,
+            E.WorkflowExecutionContinuedAsNew))
+        def _():
+            close_status = (
+                m(E.WorkflowExecutionCompleted) * int(CloseStatus.Completed)
+                + m(E.WorkflowExecutionFailed) * int(CloseStatus.Failed)
+                + m(E.WorkflowExecutionTimedOut) * int(CloseStatus.TimedOut)
+                + m(E.WorkflowExecutionCanceled) * int(CloseStatus.Canceled)
+                + m(E.WorkflowExecutionTerminated)
+                * int(CloseStatus.Terminated)
+                + m(E.WorkflowExecutionContinuedAsNew)
+                * int(CloseStatus.ContinuedAsNew)
+            )
+            m_close = close_status > 0
+            wr(X + S.X_STATE, m_close, int(WorkflowState.Completed))
+            wr(X + S.X_CLOSE_STATUS, m_close, close_status)
+            wr(X + S.X_COMPLETION_EVENT_BATCH_ID, m_close, batch_first)
+
+        @pl.when(present(E.WorkflowExecutionCancelRequested))
+        def _():
+            m_creq = m(E.WorkflowExecutionCancelRequested)
+            wr(X + S.X_CANCEL_REQUESTED, m_creq, 1)
+
+        @pl.when(present(E.WorkflowExecutionSignaled))
+        def _():
+            m_sig = m(E.WorkflowExecutionSignaled)
+            wr(X + S.X_SIGNAL_COUNT, m_sig, rd(X + S.X_SIGNAL_COUNT) + 1)
+
+        # ---- decision sub-FSM
+        @pl.when(present(E.DecisionTaskScheduled))
+        def _():
+            m_dsch = m(E.DecisionTaskScheduled)
+            wr(X + S.X_DEC_VERSION, m_dsch, version)
+            wr(X + S.X_DEC_SCHEDULE_ID, m_dsch, ev_id)
+            wr(X + S.X_DEC_STARTED_ID, m_dsch, EMPTY_EVENT_ID)
+            wr(X + S.X_DEC_TIMEOUT, m_dsch, a0)
+            wr(X + S.X_DEC_ATTEMPT, m_dsch, a1)
+            wr(X + S.X_DEC_SCHEDULED_TS, m_dsch, ts)
+            wr(X + S.X_DEC_ORIGINAL_SCHEDULED_TS, m_dsch, ts)
+            wr(X + S.X_DEC_STARTED_TS, m_dsch, 0)
+
+        @pl.when(present(E.DecisionTaskStarted))
+        def _():
+            m_dsta = m(E.DecisionTaskStarted)
+            wr(X + S.X_STATE,
+               m_dsta & (rd(X + S.X_STATE) == int(WorkflowState.Created)),
+               int(WorkflowState.Running))
+            wr(X + S.X_DEC_VERSION, m_dsta, version)
+            wr(X + S.X_DEC_STARTED_ID, m_dsta, ev_id)
+            wr(X + S.X_DEC_ATTEMPT, m_dsta, 0)
+            wr(X + S.X_DEC_STARTED_TS, m_dsta, ts)
+
+        @pl.when(present(E.DecisionTaskCompleted))
+        def _():
+            m_dcom = m(E.DecisionTaskCompleted)
+            wr(X + S.X_DEC_VERSION, m_dcom, EMPTY_VERSION)
+            wr(X + S.X_DEC_SCHEDULE_ID, m_dcom, EMPTY_EVENT_ID)
+            wr(X + S.X_DEC_STARTED_ID, m_dcom, EMPTY_EVENT_ID)
+            for col in (S.X_DEC_TIMEOUT, S.X_DEC_ATTEMPT,
+                        S.X_DEC_SCHEDULED_TS, S.X_DEC_STARTED_TS):
+                wr(X + col, m_dcom, 0)
+            wr(X + S.X_LAST_PROCESSED_EVENT, m_dcom, a0)
+
+        @pl.when(present(E.DecisionTaskTimedOut, E.DecisionTaskFailed))
+        def _():
+            m_dto = m(E.DecisionTaskTimedOut)
+            m_dfail = m(E.DecisionTaskFailed)
+            increment = m_dfail | (
+                m_dto & (a0 != int(TimeoutType.ScheduleToStart))
+            )
+            no_increment = (m_dto | m_dfail) & ~increment
+            new_attempt = rd(X + S.X_DEC_ATTEMPT) + 1
+            wr(X + S.X_DEC_VERSION, increment, rd(X + S.X_CUR_VERSION))
+            wr(X + S.X_DEC_SCHEDULE_ID, increment, batch_first)
+            wr(X + S.X_DEC_STARTED_ID, increment, EMPTY_EVENT_ID)
+            wr(X + S.X_DEC_TIMEOUT, increment,
+               rd(X + S.X_DECISION_TIMEOUT_VALUE))
+            wr(X + S.X_DEC_ATTEMPT, increment, new_attempt)
+            wr(X + S.X_DEC_SCHEDULED_TS, increment, ts)
+            wr(X + S.X_DEC_STARTED_TS, increment, 0)
+            wr(X + S.X_DEC_ORIGINAL_SCHEDULED_TS, increment, 0)
+
+            wr(X + S.X_DEC_VERSION, no_increment, EMPTY_VERSION)
+            wr(X + S.X_DEC_SCHEDULE_ID, no_increment, EMPTY_EVENT_ID)
+            wr(X + S.X_DEC_STARTED_ID, no_increment, EMPTY_EVENT_ID)
+            for col in (S.X_DEC_TIMEOUT, S.X_DEC_ATTEMPT,
+                        S.X_DEC_SCHEDULED_TS, S.X_DEC_STARTED_TS,
+                        S.X_DEC_ORIGINAL_SCHEDULED_TS):
+                wr(X + col, no_increment, 0)
+
+        # ---- slot-table helper: per-slot predicated updates
+        def for_slots(types, cap, fn):
+            @pl.when(present(*types))
+            def _():
+                base_mask = m(*types)
+                for s_i in range(cap):
+                    # scalar slot-presence gate (bit aliases across slot
+                    # tables and mod 32 — a false positive only runs the
+                    # masked writes with an all-false mask, a no-op)
+                    @pl.when((((w_slot >> (s_i % 32)) & 1) != 0))
+                    def _(s_i=s_i):
+                        mask_s = base_mask & (slot == s_i)
+                        fn(s_i, mask_s)
+
+        # ---- pending activities
+        A = rm.act0
+
+        def act_sched(s_i, mask_s):
+            r = A + s_i * S.AC_N
+            exp_interval = jnp.where((a5 > 0) & (a6 > a2), a6, a2)
+            vals = {
+                S.AC_OCC: 1, S.AC_VERSION: version,
+                S.AC_SCHEDULE_ID: ev_id,
+                S.AC_SCHEDULED_BATCH_ID: batch_first,
+                S.AC_SCHEDULED_TS: ts, S.AC_STARTED_ID: EMPTY_EVENT_ID,
+                S.AC_STARTED_TS: 0, S.AC_ID_HASH: a0,
+                S.AC_SCH_TO_START: a1, S.AC_SCH_TO_CLOSE: a2,
+                S.AC_START_TO_CLOSE: a3, S.AC_HEARTBEAT: a4,
+                S.AC_CANCEL_REQUESTED: 0,
+                S.AC_CANCEL_REQUEST_ID: EMPTY_EVENT_ID,
+                S.AC_ATTEMPT: 0, S.AC_HAS_RETRY: a5,
+                S.AC_EXPIRATION_TS: ts + exp_interval,
+                S.AC_LAST_HB_TS: 0, S.AC_TIMER_STATUS: 0,
+            }
+            for col in range(S.AC_N):
+                wr(r + col, mask_s, vals[col])
+
+        for_slots((E.ActivityTaskScheduled,), caps.max_activities,
+                  act_sched)
+
+        def act_start(s_i, mask_s):
+            r = A + s_i * S.AC_N
+            wr(r + S.AC_VERSION, mask_s, version)
+            wr(r + S.AC_STARTED_ID, mask_s, ev_id)
+            wr(r + S.AC_STARTED_TS, mask_s, ts)
+            wr(r + S.AC_LAST_HB_TS, mask_s, ts)
+            wr(r + S.AC_ATTEMPT, mask_s, a1)
+
+        for_slots((E.ActivityTaskStarted,), caps.max_activities,
+                  act_start)
+
+        def act_close(s_i, mask_s):
+            r = A + s_i * S.AC_N
+            for col in range(S.AC_N):
+                wr(r + col, mask_s, 0)
+
+        for_slots(
+            (E.ActivityTaskCompleted, E.ActivityTaskFailed,
+             E.ActivityTaskTimedOut, E.ActivityTaskCanceled),
+            caps.max_activities, act_close,
+        )
+
+        def act_creq(s_i, mask_s):
+            r = A + s_i * S.AC_N
+            wr(r + S.AC_VERSION, mask_s, version)
+            wr(r + S.AC_CANCEL_REQUESTED, mask_s, 1)
+            wr(r + S.AC_CANCEL_REQUEST_ID, mask_s, ev_id)
+
+        for_slots((E.ActivityTaskCancelRequested,), caps.max_activities,
+                  act_creq)
+
+        # ---- pending timers
+        T_ = rm.tim0
+
+        def tim_start(s_i, mask_s):
+            r = T_ + s_i * S.TI_N
+            wr(r + S.TI_OCC, mask_s, 1)
+            wr(r + S.TI_VERSION, mask_s, version)
+            wr(r + S.TI_STARTED_ID, mask_s, ev_id)
+            wr(r + S.TI_ID_HASH, mask_s, a0)
+            wr(r + S.TI_EXPIRY_TS, mask_s, ts + a1)
+            wr(r + S.TI_STATUS, mask_s, 0)
+
+        for_slots((E.TimerStarted,), caps.max_timers, tim_start)
+
+        def tim_close(s_i, mask_s):
+            r = T_ + s_i * S.TI_N
+            for col in range(S.TI_N):
+                wr(r + col, mask_s, 0)
+
+        for_slots((E.TimerFired, E.TimerCanceled), caps.max_timers,
+                  tim_close)
+
+        # ---- pending children
+        C_ = rm.chd0
+
+        def chd_init(s_i, mask_s):
+            r = C_ + s_i * S.CH_N
+            vals = {
+                S.CH_OCC: 1, S.CH_VERSION: version,
+                S.CH_INITIATED_ID: ev_id,
+                S.CH_INITIATED_BATCH_ID: batch_first,
+                S.CH_STARTED_ID: EMPTY_EVENT_ID, S.CH_WF_ID_HASH: a0,
+                S.CH_RUN_ID_HASH: 0, S.CH_POLICY: a1,
+            }
+            for col in range(S.CH_N):
+                wr(r + col, mask_s, vals[col])
+
+        for_slots((E.StartChildWorkflowExecutionInitiated,),
+                  caps.max_children, chd_init)
+
+        def chd_start(s_i, mask_s):
+            r = C_ + s_i * S.CH_N
+            wr(r + S.CH_STARTED_ID, mask_s, ev_id)
+            wr(r + S.CH_RUN_ID_HASH, mask_s, a1)
+
+        for_slots((E.ChildWorkflowExecutionStarted,), caps.max_children,
+                  chd_start)
+
+        def chd_close(s_i, mask_s):
+            r = C_ + s_i * S.CH_N
+            for col in range(S.CH_N):
+                wr(r + col, mask_s, 0)
+
+        for_slots(
+            (E.StartChildWorkflowExecutionFailed,
+             E.ChildWorkflowExecutionCompleted,
+             E.ChildWorkflowExecutionFailed,
+             E.ChildWorkflowExecutionCanceled,
+             E.ChildWorkflowExecutionTimedOut,
+             E.ChildWorkflowExecutionTerminated),
+            caps.max_children, chd_close,
+        )
+
+        # ---- pending external cancels / signals
+        def rc_init(s_i, mask_s):
+            r = rm.rc0 + s_i * S.RC_N
+            wr(r + 0, mask_s, 1)
+            wr(r + 1, mask_s, version)
+            wr(r + 2, mask_s, ev_id)
+            wr(r + 3, mask_s, batch_first)
+
+        for_slots((E.RequestCancelExternalWorkflowExecutionInitiated,),
+                  caps.max_request_cancels, rc_init)
+
+        def rc_close(s_i, mask_s):
+            r = rm.rc0 + s_i * S.RC_N
+            for col in range(S.RC_N):
+                wr(r + col, mask_s, 0)
+
+        for_slots(
+            (E.RequestCancelExternalWorkflowExecutionFailed,
+             E.ExternalWorkflowExecutionCancelRequested),
+            caps.max_request_cancels, rc_close,
+        )
+
+        def sg_init(s_i, mask_s):
+            r = rm.sg0 + s_i * S.SG_N
+            wr(r + 0, mask_s, 1)
+            wr(r + 1, mask_s, version)
+            wr(r + 2, mask_s, ev_id)
+            wr(r + 3, mask_s, batch_first)
+
+        for_slots((E.SignalExternalWorkflowExecutionInitiated,),
+                  caps.max_signals_ext, sg_init)
+
+        def sg_close(s_i, mask_s):
+            r = rm.sg0 + s_i * S.SG_N
+            for col in range(S.SG_N):
+                wr(r + col, mask_s, 0)
+
+        for_slots(
+            (E.SignalExternalWorkflowExecutionFailed,
+             E.ExternalWorkflowExecutionSignaled),
+            caps.max_signals_ext, sg_close,
+        )
+        return carry
+
+    lax.fori_loop(0, tb, step, 0)
+
+
+BT = 1024  # batch tile = one (8, 128) int32 VPU tile
+
+
+@functools.partial(jax.jit, static_argnames=("caps", "tb", "interpret"))
+def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
+                        tb: int, interpret: bool):
+    """events_teb: [T, EV_N, B] int32; rows0: [R, B]. Returns [R, B].
+
+    B must be a multiple of BT; each batch tile is viewed as (8, 128).
+    """
+    rm = RowMap(caps)
+    T, ev_n, B = events_teb.shape
+    R = rm.rows_padded
+    n_bt = B // BT
+    ev5 = events_teb.reshape(T, ev_n, n_bt, 8, 128)
+    rows5 = rows0.reshape(R, n_bt, 8, 128)
+
+    # per-(step, tile) event-type presence bitmask, computed in parallel
+    # here so the kernel's sequential loop reads scalars from SMEM
+    et = ev5[:, S.EV_TYPE]  # [T, n_bt, 8, 128]
+    et_valid = et >= 0
+    word = jnp.where(et_valid, et // 32, 0)
+    bit = jnp.where(et_valid, jnp.left_shift(1, et % 32), 0)
+    slot_v = ev5[:, S.EV_SLOT]  # [T, n_bt, 8, 128]
+    slot_ok = et_valid & (slot_v >= 0)
+    slot_bit = jnp.where(slot_ok, jnp.left_shift(1, slot_v % 32), 0)
+    words = [
+        lax.reduce(
+            jnp.where(et_valid & (word == w), bit, 0),
+            jnp.int32(0), lax.bitwise_or, (2, 3),
+        )
+        for w in (0, 1)
+    ]
+    words.append(lax.reduce(slot_bit, jnp.int32(0), lax.bitwise_or, (2, 3)))
+    words.append(jnp.zeros_like(words[0]))
+    presence = jnp.stack(words, axis=-1).astype(jnp.int32)  # [T, n_bt, 4]
+    presence = jnp.transpose(presence, (1, 0, 2))  # [n_bt, T, 4]
+
+    grid = (n_bt, T // tb)
+    out = pl.pallas_call(
+        functools.partial(_kernel, rm=rm, tb=tb),
+        out_shape=jax.ShapeDtypeStruct((R, n_bt, 8, 128), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tb, 4), lambda b, t: (b, t, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tb, ev_n, 1, 8, 128),
+                         lambda b, t: (t, 0, b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1, 8, 128), lambda b, t: (0, b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, 1, 8, 128), lambda b, t: (0, b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(presence, ev5, rows5)
+    return out.reshape(R, B)
+
+
+def replay_scan_pallas(
+    state: S.StateTensors,
+    events_tm,
+    caps: S.Capacities,
+    tb: int = 64,
+    interpret: bool | None = None,
+) -> S.StateTensors:
+    """Drop-in equivalent of ops.replay.replay_scan on the Pallas kernel.
+
+    events_tm: [T, B, EV_N] (the packer's time-major layout). Pads B to
+    a multiple of BT (with invalid events + empty state) and T to a
+    multiple of ``tb`` (invalid events are no-ops).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T, B, ev_n = events_tm.shape
+    rm = RowMap(caps)
+    b_pad = (-B) % BT
+    t_pad = (-T) % tb
+
+    events_teb = jnp.transpose(jnp.asarray(events_tm), (0, 2, 1))
+    if t_pad or b_pad:
+        fill = jnp.zeros((t_pad + T, ev_n, B + b_pad), jnp.int32)
+        fill = fill.at[:, S.EV_TYPE, :].set(-1)
+        events_teb = fill.at[:T, :, :B].set(events_teb)
+
+    rows0 = state_to_rows(state, rm)
+    if b_pad:
+        pad_state = S.empty_state(b_pad, caps)
+        pad_state = jax.tree_util.tree_map(jnp.asarray, pad_state)
+        rows0 = jnp.concatenate(
+            [rows0, state_to_rows(pad_state, rm)], axis=1
+        )
+
+    rows = _replay_rows_pallas(events_teb, rows0, caps, tb, interpret)
+    return rows_to_state(rows[:, :B], rm)

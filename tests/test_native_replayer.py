@@ -1,0 +1,75 @@
+"""Differential tests for the C++ sequential replayer — the compiled-host
+baseline bench.py measures the TPU kernel against.
+
+Parity contract: for any packed batch, ct_replay_sequential produces
+bit-identical StateTensors to the TPU kernel (ops/replay.py), which is
+itself differential-tested against the host oracle
+(core/state_builder.py). This pins both the C++ column constants and the
+transition semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cadence_tpu import native
+from cadence_tpu.ops import schema as S
+from cadence_tpu.ops.pack import pack_histories
+from cadence_tpu.ops.replay import replay_packed
+from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+
+@pytest.fixture(scope="module")
+def lib():
+    loaded = native._load()
+    if loaded is None:
+        pytest.skip("g++ unavailable: native sidecar not built")
+    return loaded
+
+
+def _assert_states_equal(a: S.StateTensors, b: S.StateTensors) -> None:
+    for name in ("exec_info", "activities", "timers", "children",
+                 "cancels", "signals", "vh_items", "vh_len"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=f"C++ replayer diverged from kernel on {name}",
+        )
+
+
+def _pack_fuzzed(seed: int, n: int, target_events: int, caps=None):
+    fz = HistoryFuzzer(seed=seed, caps=caps)
+    return pack_histories(
+        [(f"wf-{i}", f"run-{i}", fz.generate(target_events=target_events))
+         for i in range(n)],
+        caps=caps,
+    )
+
+
+class TestSequentialReplayer:
+    def test_matches_kernel_small_batch(self, lib):
+        packed = _pack_fuzzed(seed=11, n=8, target_events=40)
+        _assert_states_equal(native.replay_sequential(packed),
+                             replay_packed(packed))
+
+    def test_matches_kernel_fuzzed_sweep(self, lib):
+        for seed in (1, 2, 3, 4, 5):
+            packed = _pack_fuzzed(seed=seed, n=6, target_events=60)
+            _assert_states_equal(native.replay_sequential(packed),
+                                 replay_packed(packed))
+
+    def test_matches_kernel_deep_histories(self, lib):
+        caps = S.Capacities(max_events=512)
+        packed = _pack_fuzzed(seed=77, n=4, target_events=400, caps=caps)
+        _assert_states_equal(native.replay_sequential(packed),
+                             replay_packed(packed))
+
+    def test_matches_kernel_padded_batch(self, lib):
+        fz = HistoryFuzzer(seed=21)
+        packed = pack_histories(
+            [(f"w{i}", f"r{i}", fz.generate(target_events=25))
+             for i in range(3)],
+            pad_batch_to=8,
+        )
+        _assert_states_equal(native.replay_sequential(packed),
+                             replay_packed(packed))

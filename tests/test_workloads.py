@@ -1,0 +1,63 @@
+"""The benchmark workload histories are valid and replay identically on
+all three paths: host oracle, TPU kernel, and C++ sequential baseline.
+This guarantees bench.py compares the same computation, not three
+different workloads.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from cadence_tpu import native
+from cadence_tpu.ops import schema as S
+from cadence_tpu.ops.pack import pack_histories
+from cadence_tpu.ops.replay import replay_packed
+from cadence_tpu.ops.unpack import mutable_state_to_snapshot, state_row_to_snapshot
+from cadence_tpu.testing import workloads as W
+from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+from test_replay_differential import oracle_replay
+
+
+def _all_workloads():
+    rng = random.Random(5)
+    fz = HistoryFuzzer(seed=5)
+    return [
+        ("echo", W.echo_history()),
+        ("signal", W.signal_history(rng)),
+        ("timer", W.timer_storm_history(rng, depth=200)),
+        ("retry", W.retry_deep_history(rng, depth=300)),
+        ("ndc", W.ndc_storm_history(fz, depth=300)),
+    ]
+
+
+def test_workloads_oracle_kernel_parity():
+    caps = S.Capacities(max_events=512)
+    hists = [(f"wf-{n}", f"run-{n}", b) for n, b in _all_workloads()]
+    packed = pack_histories(hists, caps=caps)
+    final = replay_packed(packed)
+    for i, (wf_id, run_id, batches) in enumerate(hists):
+        kernel_snap = state_row_to_snapshot(final, i, packed.epoch_s)
+        oracle_snap = mutable_state_to_snapshot(
+            oracle_replay(batches, workflow_id=wf_id, run_id=run_id)
+        )
+        assert kernel_snap == oracle_snap, f"workload {wf_id} diverged"
+
+
+def test_workloads_cpp_baseline_parity():
+    if native._load() is None:
+        pytest.skip("native sidecar unavailable")
+    caps = S.Capacities(max_events=512)
+    hists = [(f"wf-{n}", f"run-{n}", b) for n, b in _all_workloads()]
+    packed = pack_histories(hists, caps=caps)
+    final = replay_packed(packed)
+    seq = native.replay_sequential(packed)
+    for f in ("exec_info", "activities", "timers", "children", "cancels",
+              "signals", "vh_items", "vh_len"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(final, f)), getattr(seq, f),
+            err_msg=f"C++ baseline diverged on {f}",
+        )
