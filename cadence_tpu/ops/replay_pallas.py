@@ -145,9 +145,12 @@ def rows_to_state(rows, rm: RowMap) -> S.StateTensors:
 def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
     """One (batch-tile, time-block) grid step.
 
-    The batch tile is shaped (8, 128) — a native int32 VPU tile — so
-    every row update runs at full sublane x lane utilization (a flat
-    [BT] row would occupy 1 of 8 sublanes).
+    The batch tile is shaped (SL, 128) with SL a multiple of 8 — whole
+    int32 VPU tiles — so every row update runs at full sublane x lane
+    utilization (a flat [BT] row would occupy 1 of 8 sublanes). Larger
+    SL amortizes per-instruction overhead of the sequential time loop
+    over more lanes (the step cost is dominated by instruction issue,
+    not data): SL=32 measures ~2.5x the events/s of SL=8 on v5e.
 
     presence_ref: [1, TB, 4] SMEM — per-step scalar gates for this
              tile: words 0-1 are the event-type bitmask (bit e of word
@@ -157,9 +160,9 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
              parallel by XLA outside the kernel, so the sequential loop
              gates each type's (and slot's) block on a SCALAR bit test
              instead of a cross-lane ``jnp.any`` reduction.
-    ev_ref:  [TB, EV_N, 1, 8, 128] — the time block's events
-    init_ref:[R, 1, 8, 128] — initial state block (only read at t==0)
-    st:      [R, 1, 8, 128] — output state block, VMEM-resident across t
+    ev_ref:  [TB, EV_N, 1, SL, 128] — the time block's events
+    init_ref:[R, 1, SL, 128] — initial state block (only read at t==0)
+    st:      [R, 1, SL, 128] — output state block, VMEM-resident across t
     """
     caps = rm.caps
     t_idx = pl.program_id(1)
@@ -529,30 +532,35 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
     lax.fori_loop(0, tb, step, 0)
 
 
-BT = 1024  # batch tile = one (8, 128) int32 VPU tile
+BT = 4096  # default batch tile = one (32, 128) int32 block per row
 
 
-@functools.partial(jax.jit, static_argnames=("caps", "tb", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("caps", "tb", "interpret", "bt"))
 def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
-                        tb: int, interpret: bool):
+                        tb: int, interpret: bool, bt: int = BT):
     """events_teb: [T, EV_N, B] int32; rows0: [R, B]. Returns [R, B].
 
-    B must be a multiple of BT; each batch tile is viewed as (8, 128).
+    B must be a multiple of ``bt``; each batch tile is viewed as
+    (bt//128, 128). ``tb * EV_N * bt * 4`` bytes of events are VMEM-
+    resident per grid step (double-buffered by Pallas) — keep it under
+    ~4MB (tb=16 at bt=4096).
     """
     rm = RowMap(caps)
+    sl = bt // 128
     T, ev_n, B = events_teb.shape
     R = rm.rows_padded
-    n_bt = B // BT
-    ev5 = events_teb.reshape(T, ev_n, n_bt, 8, 128)
-    rows5 = rows0.reshape(R, n_bt, 8, 128)
+    n_bt = B // bt
+    ev5 = events_teb.reshape(T, ev_n, n_bt, sl, 128)
+    rows5 = rows0.reshape(R, n_bt, sl, 128)
 
     # per-(step, tile) event-type presence bitmask, computed in parallel
     # here so the kernel's sequential loop reads scalars from SMEM
-    et = ev5[:, S.EV_TYPE]  # [T, n_bt, 8, 128]
+    et = ev5[:, S.EV_TYPE]  # [T, n_bt, sl, 128]
     et_valid = et >= 0
     word = jnp.where(et_valid, et // 32, 0)
     bit = jnp.where(et_valid, jnp.left_shift(1, et % 32), 0)
-    slot_v = ev5[:, S.EV_SLOT]  # [T, n_bt, 8, 128]
+    slot_v = ev5[:, S.EV_SLOT]  # [T, n_bt, sl, 128]
     slot_ok = et_valid & (slot_v >= 0)
     slot_bit = jnp.where(slot_ok, jnp.left_shift(1, slot_v % 32), 0)
     words = [
@@ -570,18 +578,18 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
     grid = (n_bt, T // tb)
     out = pl.pallas_call(
         functools.partial(_kernel, rm=rm, tb=tb),
-        out_shape=jax.ShapeDtypeStruct((R, n_bt, 8, 128), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((R, n_bt, sl, 128), jnp.int32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, tb, 4), lambda b, t: (b, t, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((tb, ev_n, 1, 8, 128),
+            pl.BlockSpec((tb, ev_n, 1, sl, 128),
                          lambda b, t: (t, 0, b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((R, 1, 8, 128), lambda b, t: (0, b, 0, 0),
+            pl.BlockSpec((R, 1, sl, 128), lambda b, t: (0, b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((R, 1, 8, 128), lambda b, t: (0, b, 0, 0),
+        out_specs=pl.BlockSpec((R, 1, sl, 128), lambda b, t: (0, b, 0, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(presence, ev5, rows5)
@@ -592,20 +600,21 @@ def replay_scan_pallas(
     state: S.StateTensors,
     events_tm,
     caps: S.Capacities,
-    tb: int = 64,
+    tb: int = 16,
     interpret: bool | None = None,
+    bt: int = BT,
 ) -> S.StateTensors:
     """Drop-in equivalent of ops.replay.replay_scan on the Pallas kernel.
 
     events_tm: [T, B, EV_N] (the packer's time-major layout). Pads B to
-    a multiple of BT (with invalid events + empty state) and T to a
+    a multiple of ``bt`` (with invalid events + empty state) and T to a
     multiple of ``tb`` (invalid events are no-ops).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     T, B, ev_n = events_tm.shape
     rm = RowMap(caps)
-    b_pad = (-B) % BT
+    b_pad = (-B) % bt
     t_pad = (-T) % tb
 
     events_teb = jnp.transpose(jnp.asarray(events_tm), (0, 2, 1))
@@ -622,5 +631,5 @@ def replay_scan_pallas(
             [rows0, state_to_rows(pad_state, rm)], axis=1
         )
 
-    rows = _replay_rows_pallas(events_teb, rows0, caps, tb, interpret)
+    rows = _replay_rows_pallas(events_teb, rows0, caps, tb, interpret, bt)
     return rows_to_state(rows[:, :B], rm)

@@ -1,0 +1,106 @@
+"""Ad-hoc perf probe: XLA scan vs Pallas kernel on the retry_deep config.
+
+Not part of the bench; used to drive kernel optimization. Run on TPU:
+    python scripts/perf_probe.py [--config retry_deep] [--batch 512]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="retry_deep")
+    ap.add_argument("--batches", default="512,2048,8192")
+    ap.add_argument("--tb", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--xla", action="store_true", help="also time XLA scan")
+    args = ap.parse_args()
+
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.pack import pack_histories
+    from cadence_tpu.ops.replay import replay_scan
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas, RowMap
+    from cadence_tpu.testing import workloads as W
+    from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+    caps_by_config = {
+        "echo": S.Capacities(max_events=16, max_activities=2, max_timers=2,
+                             max_children=2, max_request_cancels=2,
+                             max_signals_ext=2, max_version_items=2),
+        "retry_deep": S.Capacities(max_events=1024, max_activities=4,
+                                   max_timers=2, max_children=2,
+                                   max_request_cancels=2, max_signals_ext=2,
+                                   max_version_items=2),
+        "ndc_storm": S.Capacities(max_events=1024),
+    }
+    caps = caps_by_config[args.config]
+    rng = random.Random(42)
+    fz = HistoryFuzzer(seed=42, caps=caps)
+
+    hs = []
+    for i in range(32):
+        if args.config == "echo":
+            b = W.echo_history()
+        elif args.config == "retry_deep":
+            b = W.retry_deep_history(rng, depth=1000)
+        else:
+            b = W.ndc_storm_history(fz, depth=1000)
+        hs.append((f"wf-{i}", f"run-{i}", b))
+    packed = pack_histories(hs, caps=caps)
+
+    rm = RowMap(caps)
+    state_bytes = rm.rows * 4
+    print(f"config={args.config} T={packed.events.shape[1]} "
+          f"state rows={rm.rows} ({state_bytes}B/workflow)")
+
+    for batch in [int(b) for b in args.batches.split(",")]:
+        n = packed.events.shape[0]
+        reps = (batch + n - 1) // n
+        events = np.tile(packed.events, (reps, 1, 1))[:batch]
+        ev_tm = jnp.asarray(np.ascontiguousarray(np.transpose(events, (1, 0, 2))))
+        T = ev_tm.shape[0]
+
+        if args.xla:
+            st = jax.tree_util.tree_map(jnp.asarray, S.empty_state(batch, caps))
+            f = jax.jit(replay_scan)
+            jax.block_until_ready(f(st, ev_tm))
+            ts = []
+            for _ in range(args.iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(st, ev_tm))
+                ts.append(time.perf_counter() - t0)
+            p50 = sorted(ts)[len(ts) // 2]
+            print(f"  B={batch:6d} XLA    {p50*1e3:9.2f} ms  "
+                  f"{p50/T*1e6:8.2f} us/step  {batch/p50:12.0f} hist/s  "
+                  f"{batch*T/p50/1e6:8.1f} Mev/s")
+
+        st = jax.tree_util.tree_map(jnp.asarray, S.empty_state(batch, caps))
+        f = lambda s, e: replay_scan_pallas(s, e, caps, tb=args.tb,
+                                            interpret=False)
+        out = f(st, ev_tm)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        ts = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            out = f(st, ev_tm)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            ts.append(time.perf_counter() - t0)
+        p50 = sorted(ts)[len(ts) // 2]
+        print(f"  B={batch:6d} pallas {p50*1e3:9.2f} ms  "
+              f"{p50/T*1e6:8.2f} us/step  {batch/p50:12.0f} hist/s  "
+              f"{batch*T/p50/1e6:8.1f} Mev/s")
+
+
+if __name__ == "__main__":
+    main()
