@@ -73,6 +73,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ct_scatter_batch_major.argtypes = (
             lib.ct_scatter_time_major.argtypes
         )
+        lib.ct_scatter_teb.argtypes = lib.ct_scatter_time_major.argtypes
+        lib.ct_presence.argtypes = [
+            i32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, i32p,
+        ]
         lib.ct_fnv1a32_batch.argtypes = [
             ctypes.c_char_p, i64p, ctypes.c_int64, u32p,
         ]
@@ -153,6 +158,81 @@ def scatter_time_major(
     for b, n in enumerate(lengths64):
         out[:n, b, :] = rows[start : start + n]
         start += n
+    return out
+
+
+def scatter_teb(
+    rows: np.ndarray, lengths: np.ndarray, max_events: int,
+    type_pad: int = -1, force_python: bool = False,
+) -> np.ndarray:
+    """[sum(lengths), E] rows + [B] lengths → [T, E, B] field-major tensor
+    (the Pallas replay kernel's native operand layout)."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lengths64 = np.ascontiguousarray(lengths, dtype=np.int64)
+    _check_scatter_args(rows, lengths64, max_events)
+    batch = len(lengths64)
+    ev_n = rows.shape[1] if rows.ndim == 2 else 0
+    lib = None if force_python else _load()
+    if lib is not None and ev_n and rows.size:
+        out = np.empty((max_events, ev_n, batch), dtype=np.int32)
+        lib.ct_scatter_teb(
+            _i32p(rows), _i64p(lengths64), batch, ev_n, max_events,
+            type_pad, _i32p(out),
+        )
+        return out
+    # numpy fallback
+    out = np.zeros((max_events, ev_n, batch), dtype=np.int32)
+    if ev_n:
+        out[:, 0, :] = type_pad
+    start = 0
+    for b, n in enumerate(lengths64):
+        out[:n, :, b] = rows[start : start + n]
+        start += n
+    return out
+
+
+def presence_masks(
+    rows: np.ndarray, lengths: np.ndarray, max_events: int, bt: int,
+    force_python: bool = False,
+) -> np.ndarray:
+    """Per-(batch-tile, step) presence bitmasks for the Pallas replay
+    kernel: [B/bt, T, 4] int32 (words 0-1 event-type bits, word 2 slot
+    bits, word 3 zero). B must be a multiple of ``bt``."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lengths64 = np.ascontiguousarray(lengths, dtype=np.int64)
+    _check_scatter_args(rows, lengths64, max_events)
+    batch = len(lengths64)
+    if batch % bt:
+        raise ValueError(f"presence: batch={batch} not a multiple of bt={bt}")
+    ev_n = rows.shape[1] if rows.ndim == 2 else 0
+    if rows.size and ev_n != 16:  # schema.EV_N; ct_presence reads cols 0 and 7
+        raise ValueError(f"presence: ev_n={ev_n} != schema EV_N=16")
+    lib = None if force_python else _load()
+    if lib is not None and ev_n and rows.size:
+        out = np.empty((batch // bt, max_events, 4), dtype=np.int32)
+        lib.ct_presence(
+            _i32p(rows), _i64p(lengths64), batch, ev_n, max_events, bt,
+            _i32p(out),
+        )
+        return out
+    # numpy fallback
+    out = np.zeros((batch // bt, max_events, 4), dtype=np.int32)
+    start = 0
+    for b, n in enumerate(lengths64):
+        n = min(int(n), max_events)
+        g = b // bt
+        ets = rows[start : start + n, 0]
+        slots = rows[start : start + n, 7]
+        ts = np.arange(n)
+        ok = ets >= 0
+        for w in (0, 1):
+            sel = ok & (ets // 32 == w)
+            np.bitwise_or.at(out[g, :, w], ts[sel],
+                             np.int32(1) << (ets[sel] % 32))
+        sel = ok & (slots >= 0)
+        np.bitwise_or.at(out[g, :, 2], ts[sel],
+                         np.int32(1) << (slots[sel] % 32))
+        start += int(lengths64[b])
     return out
 
 

@@ -110,6 +110,31 @@ class PackedHistories:
             )
         return np.ascontiguousarray(np.transpose(self.events, (1, 0, 2)))
 
+    def teb(self) -> np.ndarray:
+        """[T, EV_N, B] field-major — the Pallas replay kernel's native
+        operand layout (ops/replay_pallas.py). Produced by the C++
+        sidecar's fused scatter so the replay path never pays a
+        device-side transpose of the event tensor."""
+        if self.rows_concat is not None:
+            from cadence_tpu.native import scatter_teb
+
+            return scatter_teb(
+                self.rows_concat, self.lengths, self.caps.max_events
+            )
+        return np.ascontiguousarray(np.transpose(self.events, (1, 2, 0)))
+
+    def presence(self, bt: int) -> Optional[np.ndarray]:
+        """[B/bt, T, 4] per-(batch-tile, step) presence bitmasks for the
+        Pallas kernel (ops/replay_pallas.py). None when the batch is not
+        a multiple of ``bt`` (the kernel then computes them on device)."""
+        if self.rows_concat is None or len(self.lengths) % bt:
+            return None
+        from cadence_tpu.native import presence_masks
+
+        return presence_masks(
+            self.rows_concat, self.lengths, self.caps.max_events, bt
+        )
+
 
 # Bounds guaranteeing every on-device `rel_ts + timeout` sum fits int32:
 # relative timestamps span < 2^28 s (~8.5 years of history) and individual

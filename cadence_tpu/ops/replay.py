@@ -342,14 +342,19 @@ def replay_step(state: S.StateTensors, ev: jnp.ndarray) -> S.StateTensors:
 
 
 def replay_scan(
-    state: S.StateTensors, events_tm: jnp.ndarray, unroll: int = 8
+    state: S.StateTensors, events_tm: jnp.ndarray,
+    unroll: Optional[int] = None,
 ) -> S.StateTensors:
     """Scan the full (time-major [T, B, EV_N]) event tensor.
 
     ``unroll``: steps fused per scan iteration — the scan is HBM-bound
     on the state carry, and unrolling lets XLA keep intermediates on
     chip across fused steps (~10-15% on v5e at unroll=8; measured in
-    bench.py's configuration)."""
+    bench.py's configuration). Defaults to 8 on TPU and 1 elsewhere:
+    unrolling only pays on the device, while on CPU (the test suite) it
+    multiplies XLA compile time by the unroll factor."""
+    if unroll is None:
+        unroll = 8 if jax.default_backend() == "tpu" else 1
     final, _ = lax.scan(
         lambda s, ev: (replay_step(s, ev), None), state, events_tm,
         unroll=unroll,

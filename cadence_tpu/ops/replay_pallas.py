@@ -142,7 +142,8 @@ def rows_to_state(rows, rm: RowMap) -> S.StateTensors:
     )
 
 
-def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
+def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int,
+            ablate: int = 0):
     """One (batch-tile, time-block) grid step.
 
     The batch tile is shaped (SL, 128) with SL a multiple of 8 — whole
@@ -191,7 +192,7 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
                 out = bit if out is None else out | bit
             return out != 0
 
-        ev = ev_ref[i]  # [EV_N, 1, 8, 128]
+        ev = ev_ref[i]  # [EV_N, 1, SL, 128]
         et = ev[S.EV_TYPE, 0]
         valid = et >= 0
 
@@ -207,6 +208,9 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
 
         X = rm.exec0
 
+        if ablate >= 5:
+            return carry
+
         def m(*types):
             out = et == int(types[0])
             for t in types[1:]:
@@ -218,6 +222,9 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
         wr(X + S.X_CUR_VERSION, valid, version)
         wr(X + S.X_NEXT_EVENT_ID, valid, ev_id + 1)
         wr(X + S.X_LAST_FIRST_EVENT_ID, valid, batch_first)
+
+        if ablate >= 4:
+            return carry
 
         # ---- version-history AddOrUpdateItem
         cap_v = caps.max_version_items
@@ -235,6 +242,9 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
             wr(rm.vh0 + 2 * i_v, wmask, ev_id)
             wr(rm.vh0 + 2 * i_v + 1, wmask, version)
         wr(rm.vhlen, valid & ~same, vh_len + 1)
+
+        if ablate >= 3:
+            return carry
 
         # ---- workflow lifecycle
         @pl.when(present(E.WorkflowExecutionStarted))
@@ -290,6 +300,9 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
             wr(X + S.X_SIGNAL_COUNT, m_sig, rd(X + S.X_SIGNAL_COUNT) + 1)
 
         # ---- decision sub-FSM
+        if ablate >= 2:
+            return carry
+
         @pl.when(present(E.DecisionTaskScheduled))
         def _():
             m_dsch = m(E.DecisionTaskScheduled)
@@ -352,6 +365,9 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int):
                 wr(X + col, no_increment, 0)
 
         # ---- slot-table helper: per-slot predicated updates
+        if ablate >= 1:
+            return carry
+
         def for_slots(types, cap, fn):
             @pl.when(present(*types))
             def _():
@@ -536,9 +552,11 @@ BT = 4096  # default batch tile = one (32, 128) int32 block per row
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("caps", "tb", "interpret", "bt"))
+                   static_argnames=("caps", "tb", "interpret", "bt",
+                                    "ablate"))
 def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
-                        tb: int, interpret: bool, bt: int = BT):
+                        tb: int, interpret: bool, bt: int = BT,
+                        ablate: int = 0, presence=None):
     """events_teb: [T, EV_N, B] int32; rows0: [R, B]. Returns [R, B].
 
     B must be a multiple of ``bt``; each batch tile is viewed as
@@ -546,6 +564,11 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
     resident per grid step (double-buffered by Pallas) — keep it under
     ~4MB (tb=16 at bt=4096).
     """
+    if bt % 1024:
+        raise ValueError(
+            f"bt={bt} must be a multiple of 1024: each batch tile is viewed "
+            "as (bt//128, 128) and bt//128 must be a multiple of 8 (whole "
+            "int32 VPU tiles, the kernel's layout assumption)")
     rm = RowMap(caps)
     sl = bt // 128
     T, ev_n, B = events_teb.shape
@@ -554,30 +577,35 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
     ev5 = events_teb.reshape(T, ev_n, n_bt, sl, 128)
     rows5 = rows0.reshape(R, n_bt, sl, 128)
 
-    # per-(step, tile) event-type presence bitmask, computed in parallel
-    # here so the kernel's sequential loop reads scalars from SMEM
-    et = ev5[:, S.EV_TYPE]  # [T, n_bt, sl, 128]
-    et_valid = et >= 0
-    word = jnp.where(et_valid, et // 32, 0)
-    bit = jnp.where(et_valid, jnp.left_shift(1, et % 32), 0)
-    slot_v = ev5[:, S.EV_SLOT]  # [T, n_bt, sl, 128]
-    slot_ok = et_valid & (slot_v >= 0)
-    slot_bit = jnp.where(slot_ok, jnp.left_shift(1, slot_v % 32), 0)
-    words = [
-        lax.reduce(
-            jnp.where(et_valid & (word == w), bit, 0),
-            jnp.int32(0), lax.bitwise_or, (2, 3),
-        )
-        for w in (0, 1)
-    ]
-    words.append(lax.reduce(slot_bit, jnp.int32(0), lax.bitwise_or, (2, 3)))
-    words.append(jnp.zeros_like(words[0]))
-    presence = jnp.stack(words, axis=-1).astype(jnp.int32)  # [T, n_bt, 4]
-    presence = jnp.transpose(presence, (1, 0, 2))  # [n_bt, T, 4]
+    if presence is None:
+        # per-(step, tile) event-type presence bitmask, computed in
+        # parallel here so the kernel's sequential loop reads scalars
+        # from SMEM. Callers that pack host-side pass it precomputed
+        # (PackedHistories.presence) — the XLA reduction over the full
+        # event tensor is a measurable share of replay time.
+        et = ev5[:, S.EV_TYPE]  # [T, n_bt, sl, 128]
+        et_valid = et >= 0
+        word = jnp.where(et_valid, et // 32, 0)
+        bit = jnp.where(et_valid, jnp.left_shift(1, et % 32), 0)
+        slot_v = ev5[:, S.EV_SLOT]  # [T, n_bt, sl, 128]
+        slot_ok = et_valid & (slot_v >= 0)
+        slot_bit = jnp.where(slot_ok, jnp.left_shift(1, slot_v % 32), 0)
+        words = [
+            lax.reduce(
+                jnp.where(et_valid & (word == w), bit, 0),
+                jnp.int32(0), lax.bitwise_or, (2, 3),
+            )
+            for w in (0, 1)
+        ]
+        words.append(
+            lax.reduce(slot_bit, jnp.int32(0), lax.bitwise_or, (2, 3)))
+        words.append(jnp.zeros_like(words[0]))
+        presence = jnp.stack(words, axis=-1).astype(jnp.int32)
+        presence = jnp.transpose(presence, (1, 0, 2))  # [n_bt, T, 4]
 
     grid = (n_bt, T // tb)
     out = pl.pallas_call(
-        functools.partial(_kernel, rm=rm, tb=tb),
+        functools.partial(_kernel, rm=rm, tb=tb, ablate=ablate),
         out_shape=jax.ShapeDtypeStruct((R, n_bt, sl, 128), jnp.int32),
         grid=grid,
         in_specs=[
@@ -591,37 +619,53 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
         ],
         out_specs=pl.BlockSpec((R, 1, sl, 128), lambda b, t: (0, b, 0, 0),
                                memory_space=pltpu.VMEM),
+        # double-buffered blocks (events x2, init x2, out x2) exceed the
+        # 16MiB default scoped-vmem budget once n_bt > 1; v5e has 128MiB
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(presence, ev5, rows5)
     return out.reshape(R, B)
 
 
-def replay_scan_pallas(
+def replay_scan_pallas_teb(
     state: S.StateTensors,
-    events_tm,
+    events_teb,
     caps: S.Capacities,
     tb: int = 16,
     interpret: bool | None = None,
     bt: int = BT,
+    ablate: int = 0,
+    presence=None,
 ) -> S.StateTensors:
-    """Drop-in equivalent of ops.replay.replay_scan on the Pallas kernel.
+    """Replay on the Pallas kernel from the field-major event layout.
 
-    events_tm: [T, B, EV_N] (the packer's time-major layout). Pads B to
-    a multiple of ``bt`` (with invalid events + empty state) and T to a
-    multiple of ``tb`` (invalid events are no-ops).
+    events_teb: [T, EV_N, B] (``PackedHistories.teb()``) — the kernel's
+    native operand layout; no device-side transpose happens here, which
+    matters: at large B transposing the event tensor costs more HBM
+    traffic than the entire replay scan. Pads B to a multiple of ``bt``
+    (invalid events + empty state) and T to a multiple of ``tb``
+    (invalid events are no-ops).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    T, B, ev_n = events_tm.shape
+    events_teb = jnp.asarray(events_teb)
+    T, ev_n, B = events_teb.shape
     rm = RowMap(caps)
     b_pad = (-B) % bt
     t_pad = (-T) % tb
 
-    events_teb = jnp.transpose(jnp.asarray(events_tm), (0, 2, 1))
     if t_pad or b_pad:
         fill = jnp.zeros((t_pad + T, ev_n, B + b_pad), jnp.int32)
         fill = fill.at[:, S.EV_TYPE, :].set(-1)
         events_teb = fill.at[:T, :, :B].set(events_teb)
+
+    if presence is not None:
+        presence = jnp.asarray(presence)
+        if b_pad:   # host masks don't cover the padded tiles
+            presence = None
+        elif t_pad:
+            presence = jnp.pad(presence, ((0, 0), (0, t_pad), (0, 0)))
 
     rows0 = state_to_rows(state, rm)
     if b_pad:
@@ -631,5 +675,29 @@ def replay_scan_pallas(
             [rows0, state_to_rows(pad_state, rm)], axis=1
         )
 
-    rows = _replay_rows_pallas(events_teb, rows0, caps, tb, interpret, bt)
+    rows = _replay_rows_pallas(events_teb, rows0, caps, tb, interpret, bt,
+                               ablate, presence)
     return rows_to_state(rows[:, :B], rm)
+
+
+def replay_scan_pallas(
+    state: S.StateTensors,
+    events_tm,
+    caps: S.Capacities,
+    tb: int = 16,
+    interpret: bool | None = None,
+    bt: int = BT,
+    ablate: int = 0,
+) -> S.StateTensors:
+    """Drop-in equivalent of ops.replay.replay_scan on the Pallas kernel.
+
+    events_tm: [T, B, EV_N] (the packer's time-major layout). Transposes
+    on device to the kernel's field-major layout — callers that can pack
+    field-major directly should use ``replay_scan_pallas_teb`` and skip
+    that cost.
+    """
+    events_teb = jnp.transpose(jnp.asarray(events_tm), (0, 2, 1))
+    return replay_scan_pallas_teb(
+        state, events_teb, caps, tb=tb, interpret=interpret, bt=bt,
+        ablate=ablate,
+    )

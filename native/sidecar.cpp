@@ -82,6 +82,72 @@ void ct_scatter_batch_major(const int32_t* rows, const int64_t* lengths,
     }
 }
 
+// field-major variant: out [max_events, ev_n, batch] — the layout the
+// Pallas replay kernel consumes directly (per-field planes with batch as
+// the contiguous minor dim, so each grid step's event block and the
+// presence pass read contiguous rows). Producing it here makes the
+// device-side transpose — which costs more than the whole replay scan at
+// large batch — disappear from the replay path.
+void ct_scatter_teb(const int32_t* rows, const int64_t* lengths,
+                    int64_t batch, int64_t ev_n, int64_t max_events,
+                    int32_t type_pad, int32_t* out) {
+    const int64_t plane = ev_n * batch;
+    const int32_t** srcs = new const int32_t*[batch];
+    {
+        const int32_t* p = rows;
+        for (int64_t b = 0; b < batch; ++b) {
+            srcs[b] = p;
+            p += lengths[b] * ev_n;
+        }
+    }
+    // writes are contiguous per (t, field) run; reads of the source rows
+    // are blocked over lanes so each block's rows stay cache-resident
+    // across the ev_n field passes
+    const int64_t BLK = 512;
+    for (int64_t t = 0; t < max_events; ++t) {
+        int32_t* tp = out + t * plane;
+        for (int64_t b0 = 0; b0 < batch; b0 += BLK) {
+            const int64_t b1 = b0 + BLK < batch ? b0 + BLK : batch;
+            for (int64_t f = 0; f < ev_n; ++f) {
+                int32_t* dst = tp + f * batch;
+                const int32_t pad = f == 0 ? type_pad : 0;
+                for (int64_t b = b0; b < b1; ++b) {
+                    dst[b] = t < lengths[b] ? srcs[b][t * ev_n + f] : pad;
+                }
+            }
+        }
+    }
+    delete[] srcs;
+}
+
+// per-(batch-tile, step) presence bitmasks for the Pallas replay kernel:
+// out [n_bt, max_events, 4] int32 with n_bt = batch / bt (batch must be a
+// multiple of bt). Words 0-1: event-type bitmask (bit e of word e/32 set
+// iff some lane of the tile has type e at step t); word 2: slot bitmask
+// (bit s%32); word 3: zero padding. Computing this during packing costs
+// one pass over the ragged rows, replacing a device-side reduction over
+// the full event tensor on every replay.
+void ct_presence(const int32_t* rows, const int64_t* lengths,
+                 int64_t batch, int64_t ev_n, int64_t max_events,
+                 int64_t bt, int32_t* out) {
+    const int64_t n_bt = batch / bt;
+    std::memset(out, 0, sizeof(int32_t) * n_bt * max_events * 4);
+    const int32_t* src = rows;
+    for (int64_t b = 0; b < batch; ++b) {
+        int32_t* tile = out + (b / bt) * max_events * 4;
+        const int64_t n = lengths[b] < max_events ? lengths[b] : max_events;
+        for (int64_t t = 0; t < n; ++t, src += ev_n) {
+            const int32_t et = src[0];   // EV_TYPE
+            const int32_t sl = src[7];   // EV_SLOT
+            if (et < 0) continue;
+            int32_t* w = tile + t * 4;
+            w[et >= 32 ? 1 : 0] |= (int32_t)1 << (et & 31);
+            if (sl >= 0) w[2] |= (int32_t)1 << (sl & 31);
+        }
+        src += (lengths[b] - n) * ev_n;
+    }
+}
+
 // -- hashing ------------------------------------------------------------
 //
 // FNV-1a 32-bit over each string, masked to 31 bits (the packer's

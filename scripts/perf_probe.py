@@ -22,7 +22,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="retry_deep")
     ap.add_argument("--batches", default="512,2048,8192")
-    ap.add_argument("--tb", type=int, default=64)
+    ap.add_argument("--tb", type=int, default=16)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--xla", action="store_true", help="also time XLA scan")
     args = ap.parse_args()

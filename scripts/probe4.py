@@ -1,0 +1,159 @@
+"""Honest perf probe: forced-materialization, amortized timing.
+
+``jax.block_until_ready`` does not reliably synchronize on this platform
+(axon); every timing here instead chains ``iters`` kernel calls and then
+fetches a scalar checksum that data-depends on the final state, so the
+wall clock covers exactly ``iters`` executions.
+
+Run on TPU:
+    python scripts/probe4.py --batches 4096,16384 --tb 16 --bt 4096
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def checksum(state) -> float:
+    """Scalar that depends on every state leaf (forces full execution)."""
+    acc = jnp.int32(0)
+    for leaf in jax.tree_util.tree_leaves(state):
+        acc = acc + jnp.sum(leaf, dtype=jnp.int32)
+    return acc
+
+
+def timeit(fn, state, ev, iters):
+    """fn: (state, ev) -> state. Returns (sec_per_call, checksum_val)."""
+    cs = jax.jit(checksum)
+    # warmup / compile
+    out = fn(state, ev)
+    v0 = np.asarray(cs(out))
+    t0 = time.perf_counter()
+    out = state
+    for _ in range(iters):
+        out = fn(out, ev)
+    v = np.asarray(cs(out))
+    dt = (time.perf_counter() - t0) / iters
+    return dt, int(v)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="retry_deep")
+    ap.add_argument("--batches", default="4096")
+    ap.add_argument("--tb", type=int, default=16)
+    ap.add_argument("--bt", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--xla", action="store_true")
+    ap.add_argument("--pallas", action="store_true")
+    ap.add_argument("--teb", action="store_true")
+    ap.add_argument("--host-presence", action="store_true")
+    args = ap.parse_args()
+
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.pack import pack_histories
+    from cadence_tpu.ops.replay import replay_scan
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas, RowMap
+    from cadence_tpu.testing import workloads as W
+    from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+    caps_by_config = {
+        "echo": S.Capacities(max_events=16, max_activities=2, max_timers=2,
+                             max_children=2, max_request_cancels=2,
+                             max_signals_ext=2, max_version_items=2),
+        "retry_deep": S.Capacities(max_events=1024, max_activities=4,
+                                   max_timers=2, max_children=2,
+                                   max_request_cancels=2, max_signals_ext=2,
+                                   max_version_items=2),
+        "ndc_storm": S.Capacities(max_events=1024),
+    }
+    caps = caps_by_config[args.config]
+    rng = random.Random(42)
+    fz = HistoryFuzzer(seed=42, caps=caps)
+
+    hs = []
+    for i in range(32):
+        if args.config == "echo":
+            b = W.echo_history()
+        elif args.config == "retry_deep":
+            b = W.retry_deep_history(rng, depth=1000)
+        else:
+            b = W.ndc_storm_history(fz, depth=1000)
+        hs.append((f"wf-{i}", f"run-{i}", b))
+    packed = pack_histories(hs, caps=caps)
+
+    rm = RowMap(caps)
+    print(f"config={args.config} T={packed.events.shape[1]} "
+          f"rows={rm.rows} ({rm.rows*4}B/workflow) backend={jax.default_backend()}")
+
+    for batch in [int(b) for b in args.batches.split(",")]:
+        n = packed.events.shape[0]
+        reps = (batch + n - 1) // n
+        events = np.tile(packed.events, (reps, 1, 1))[:batch]
+        ev_tm = jnp.asarray(np.ascontiguousarray(np.transpose(events, (1, 0, 2))))
+        T = ev_tm.shape[0]
+        state0 = jax.tree_util.tree_map(jnp.asarray, S.empty_state(batch, caps))
+        state_bytes = sum(x.size * 4 for x in jax.tree_util.tree_leaves(state0))
+
+        if args.xla:
+            f = jax.jit(replay_scan)
+            dt, v = timeit(f, state0, ev_tm, args.iters)
+            hbm = 2 * state_bytes + batch * S.EV_N * 4  # state r+w + events, per step
+            print(f"  B={batch:6d} XLA    {dt*1e3:9.2f} ms  "
+                  f"{dt/T*1e6:8.2f} us/step  {batch/dt:12.0f} hist/s  "
+                  f"{batch*T/dt/1e6:8.1f} Mev/s  "
+                  f"{hbm/ (dt/T) / 1e9:7.1f} GB/s-equiv  cs={v}")
+
+        if args.teb:
+            from cadence_tpu.native import presence_masks
+            from cadence_tpu.ops.replay_pallas import replay_scan_pallas_teb
+            ev_teb = jnp.asarray(np.ascontiguousarray(
+                np.transpose(events, (1, 2, 0))))
+            pres = None
+            if args.host_presence and batch % args.bt == 0:
+                rows_cat = events.reshape(-1, S.EV_N)
+                lens = np.full(batch, T, np.int64)
+                valid = events[:, :, S.EV_TYPE].reshape(batch, T) >= 0
+                lens = valid.sum(axis=1).astype(np.int64)
+                # rows_concat excludes padding rows
+                rows_cat = events[valid]
+                pres = jnp.asarray(presence_masks(rows_cat, lens, T, args.bt))
+            f = jax.jit(lambda s, e: replay_scan_pallas_teb(
+                s, e, caps, tb=args.tb, interpret=False, bt=args.bt,
+                presence=pres))
+            try:
+                dt, v = timeit(f, state0, ev_teb, args.iters)
+                print(f"  B={batch:6d} teb    {dt*1e3:9.2f} ms  "
+                      f"{dt/T*1e6:8.2f} us/step  {batch/dt:12.0f} hist/s  "
+                      f"{batch*T/dt/1e6:8.1f} Mev/s  cs={v}")
+            except Exception as exc:
+                print(f"  B={batch:6d} teb FAILED: {type(exc).__name__}: "
+                      f"{str(exc)[:300]}")
+
+        if args.pallas:
+            f = jax.jit(lambda s, e: replay_scan_pallas(
+                s, e, caps, tb=args.tb, interpret=False, bt=args.bt))
+            try:
+                dt, v = timeit(f, state0, ev_tm, args.iters)
+            except Exception as exc:
+                print(f"  B={batch:6d} pallas tb={args.tb} bt={args.bt} "
+                      f"FAILED: {type(exc).__name__}: {str(exc)[:300]}")
+                continue
+            ev_bytes = batch * S.EV_N * 4
+            print(f"  B={batch:6d} pallas {dt*1e3:9.2f} ms  "
+                  f"{dt/T*1e6:8.2f} us/step  {batch/dt:12.0f} hist/s  "
+                  f"{batch*T/dt/1e6:8.1f} Mev/s  "
+                  f"{ev_bytes/(dt/T)/1e9:7.1f} GB/s-equiv  cs={v}")
+
+
+if __name__ == "__main__":
+    main()

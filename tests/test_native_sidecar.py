@@ -45,6 +45,46 @@ class TestScatter:
         ref = native.scatter_batch_major(rows, lengths, T, force_python=True)
         np.testing.assert_array_equal(nat, ref)
 
+    def test_teb_matches_python(self, lib):
+        rows, lengths, T = _ragged(seed=13)
+        nat = native.scatter_teb(rows, lengths, T)
+        ref = native.scatter_teb(rows, lengths, T, force_python=True)
+        np.testing.assert_array_equal(nat, ref)
+        # teb is the transpose of time-major
+        tm = native.scatter_time_major(rows, lengths, T)
+        np.testing.assert_array_equal(nat, np.transpose(tm, (0, 2, 1)))
+
+    def test_presence_matches_python(self, lib):
+        rng = np.random.default_rng(21)
+        batch, ev_n, T, bt = 8, 16, 12, 4
+        lengths = rng.integers(0, T + 1, size=batch)
+        rows = rng.integers(-1000, 1000,
+                            size=(int(lengths.sum()), ev_n)).astype(np.int32)
+        rows[:, 0] = rng.integers(0, 42, size=len(rows))   # EV_TYPE
+        rows[:, 7] = rng.integers(-1, 6, size=len(rows))   # EV_SLOT
+        nat = native.presence_masks(rows, lengths, T, bt)
+        ref = native.presence_masks(rows, lengths, T, bt, force_python=True)
+        np.testing.assert_array_equal(nat, ref)
+        assert nat.shape == (batch // bt, T, 4)
+        assert (nat[:, :, 3] == 0).all()
+        # hand-check one tile/step: bits of every type present at t=0
+        want0 = 0
+        start = 0
+        for b in range(bt):
+            if lengths[b] > 0:
+                et = int(rows[start, 0])
+                if 0 <= et < 32:
+                    want0 |= 1 << et
+            start += int(lengths[b])
+        assert int(np.uint32(nat[0, 0, 0])) == want0
+
+    def test_presence_rejects_wrong_width(self, lib):
+        rows = np.zeros((4, 6), dtype=np.int32)
+        lengths = np.array([2, 2], dtype=np.int64)
+        for force in (False, True):
+            with pytest.raises(ValueError):
+                native.presence_masks(rows, lengths, 4, 2, force_python=force)
+
     def test_empty_batch(self, lib):
         out = native.scatter_time_major(
             np.zeros((0, 4), dtype=np.int32), np.zeros(3, dtype=np.int64), 5

@@ -37,8 +37,18 @@ CAPS = S.Capacities(
 )
 
 
-def _pack(histories):
-    return pack_histories(histories, caps=CAPS)
+# Interpret-mode cost scales with T x rows; the fast subset uses a tiny
+# event budget so one parity case always runs in the default suite.
+FAST_CAPS = S.Capacities(
+    max_events=16, max_activities=2, max_timers=2, max_children=2,
+    max_request_cancels=1, max_signals_ext=1, max_version_items=2,
+)
+
+slow = pytest.mark.slow
+
+
+def _pack(histories, caps=CAPS):
+    return pack_histories(histories, caps=caps)
 
 
 def _assert_state_equal(a: S.StateTensors, b: S.StateTensors):
@@ -50,16 +60,28 @@ def _assert_state_equal(a: S.StateTensors, b: S.StateTensors):
         )
 
 
-def _parity(histories, tb=8, bt=1024):
-    packed = _pack(histories)
+def _parity(histories, tb=8, bt=1024, caps=CAPS, use_teb=False,
+            pad_batch_to=None):
+    packed = pack_histories(histories, caps=caps, pad_batch_to=pad_batch_to)
     b = packed.events.shape[0]
     ev_tm = jnp.asarray(
         np.ascontiguousarray(np.transpose(packed.events, (1, 0, 2)))
     )
-    state0 = jax.tree_util.tree_map(jnp.asarray, S.empty_state(b, CAPS))
+    state0 = jax.tree_util.tree_map(jnp.asarray, S.empty_state(b, caps))
     want = replay_scan(state0, ev_tm)
-    got = replay_scan_pallas(state0, ev_tm, CAPS, tb=tb, interpret=True,
-                             bt=bt)
+    if use_teb:
+        from cadence_tpu.ops.replay_pallas import replay_scan_pallas_teb
+
+        pres = packed.presence(bt)
+        if pad_batch_to is not None:
+            assert pres is not None, "host presence path not exercised"
+        got = replay_scan_pallas_teb(
+            state0, jnp.asarray(packed.teb()), caps, tb=tb, interpret=True,
+            bt=bt, presence=pres,
+        )
+    else:
+        got = replay_scan_pallas(state0, ev_tm, caps, tb=tb,
+                                 interpret=True, bt=bt)
     _assert_state_equal(got, want)
 
 
@@ -80,10 +102,12 @@ def test_rowmap_roundtrip():
     _assert_state_equal(back, final)
 
 
+@slow
 def test_parity_echo():
     _parity([(f"wf-{i}", f"run-{i}", W.echo_history()) for i in range(7)])
 
 
+@slow
 def test_parity_workloads():
     rng = random.Random(7)
     hs = [
@@ -96,6 +120,7 @@ def test_parity_workloads():
     _parity(hs)
 
 
+@slow
 def test_parity_fuzzed():
     """Fuzzer histories: random valid walks over every event type."""
     fz = HistoryFuzzer(seed=11, caps=CAPS)
@@ -106,6 +131,7 @@ def test_parity_fuzzed():
     _parity(hs)
 
 
+@slow
 def test_parity_fuzzed_version_bumps():
     """Failover-version jumps exercise the version-history ring."""
     fz = HistoryFuzzer(seed=3, caps=CAPS, version_bump_prob=0.4)
@@ -116,6 +142,7 @@ def test_parity_fuzzed_version_bumps():
     _parity(hs)
 
 
+@slow
 def test_parity_padding():
     """B not a multiple of bt and T not a multiple of tb both pad."""
     fz = HistoryFuzzer(seed=5, caps=CAPS)
@@ -126,6 +153,7 @@ def test_parity_padding():
     _parity(hs, tb=7, bt=1024)
 
 
+@slow
 def test_parity_larger_tile():
     """bt=2048 (SL=16) exercises the multi-register tile path."""
     fz = HistoryFuzzer(seed=9, caps=CAPS)
@@ -134,3 +162,18 @@ def test_parity_larger_tile():
         for i in range(6)
     ]
     _parity(hs, tb=8, bt=2048)
+
+
+def test_parity_fast():
+    """Minimal always-on parity case: tiny caps + fuzzed walks, via the
+    field-major (teb) path with host-computed presence masks — the
+    configuration the serving path uses."""
+    fz = HistoryFuzzer(seed=2, caps=FAST_CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}", fz.generate(target_events=12))
+        for i in range(4)
+    ]
+    # pad the batch to bt so PackedHistories.presence returns real host
+    # masks (None would fall back to the on-device computation)
+    _parity(hs, tb=8, bt=1024, caps=FAST_CAPS, use_teb=True,
+            pad_batch_to=1024)
