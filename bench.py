@@ -16,6 +16,23 @@ this host, on the same packed tensors, so ``vs_baseline`` compares the
 same computation on the same data. If anything it is a *stronger*
 baseline than Go, which replays into pointer-heavy structs and maps.
 
+Timing discipline: ``jax.block_until_ready`` does not reliably
+synchronize on this platform (axon tunnel), so every device timing
+chains ``iters`` dependent kernel calls and then fetches a scalar
+checksum that data-depends on the final state — the wall clock covers
+exactly ``iters`` full executions, nothing hides in the async queue.
+
+Two device kernels are reported side by side:
+  xla     lax.scan over replay_step (ops/replay.py) — state carry
+          round-trips HBM every step
+  pallas  VMEM-resident-state kernel (ops/replay_pallas.py), fed the
+          field-major event layout + host-precomputed presence masks
+          from the C++ packer — bound by streaming the event tensor
+
+The roofline column reports the effective HBM bandwidth implied by each
+kernel's event+state traffic vs the measured copy bandwidth of this
+chip (``streams_gbps`` / ``copy_bw_gbps``).
+
 Workload configs (BASELINE.md / reference canary/const.go:64-84):
   echo        1k-class workflows, ~11-event histories
   signal      signal-heavy ragged histories
@@ -24,8 +41,8 @@ Workload configs (BASELINE.md / reference canary/const.go:64-84):
   ndc_storm   mixed fuzzer histories + ICI snapshot exchange
 
 Prints ONE JSON line: the headline metric (histories/s at ~1k-event
-depth, vs_baseline against the C++ replayer) plus per-config numbers and
-p50 batched-rebuild latency under "configs".
+depth, vs_baseline against the C++ replayer) plus per-config numbers
+under "configs".
 """
 
 from __future__ import annotations
@@ -67,7 +84,7 @@ def _build_histories(config: str, n_unique: int, caps):
 
 
 def _tile(packed, batch: int):
-    """Tile a packed batch of uniques up to `batch` rows."""
+    """Tile a packed batch of uniques up to `batch` rows (batch-major)."""
     n = packed.events.shape[0]
     reps = (batch + n - 1) // n
     events = np.tile(packed.events, (reps, 1, 1))[:batch]
@@ -75,45 +92,124 @@ def _tile(packed, batch: int):
     return events, lengths
 
 
+def _checksum(state):
+    acc = jnp.int32(0)
+    for leaf in jax.tree_util.tree_leaves(state):
+        acc = acc + jnp.sum(leaf, dtype=jnp.int32)
+    return acc
+
+
+def _time_chained(fn, state0, iters):
+    """fn: state -> (state, aux). Forced-materialization amortized s/call.
+
+    Chains the state through ``iters`` calls and fetches a checksum that
+    data-depends on the last call's full output (state + aux)."""
+    cs = jax.jit(lambda out: _checksum(out))
+    out = fn(state0)                      # compile + warm
+    np.asarray(cs(out))
+    t0 = time.perf_counter()
+    st = state0
+    for _ in range(iters):
+        out = fn(st)
+        st = out[0]
+    v = int(np.asarray(cs(out)))
+    return (time.perf_counter() - t0) / iters, v
+
+
+def measure_copy_bw_gbps(nbytes: int = 1 << 28) -> float:
+    """Measured r+w HBM bandwidth of a jitted elementwise copy."""
+    x = jax.jit(lambda k: jax.random.randint(
+        k, (nbytes // 4,), 0, 100, jnp.int32))(jax.random.PRNGKey(0))
+    f = jax.jit(lambda x: x + 1)
+    y = f(x)
+    np.asarray(jnp.sum(y[:1]))
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = f(y)
+    np.asarray(jnp.sum(y[:1]))
+    dt = (time.perf_counter() - t0) / iters
+    return 2 * nbytes / dt / 1e9
+
+
 def _bench_config(config: str, caps, batch: int, iters: int,
-                  baseline_histories: int):
-    """Returns (device_rate, cpp_rate, mean_depth, p50_ms)."""
+                  baseline_histories: int, bt: int, tb: int,
+                  use_pallas: bool):
+    """Returns a per-config result dict."""
     from cadence_tpu import native
+    from cadence_tpu.native import presence_masks
     from cadence_tpu.ops import schema as S
     from cadence_tpu.ops.pack import pack_histories
     from cadence_tpu.ops.refresh import refresh_tasks_device
     from cadence_tpu.ops.replay import replay_scan
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas_teb
 
     n_unique = min(32, batch)
     packed = pack_histories(_build_histories(config, n_unique, caps),
                             caps=caps)
     events, lengths = _tile(packed, batch)
     mean_depth = float(lengths.mean())
-    events_tm = jnp.asarray(
-        np.ascontiguousarray(np.transpose(events, (1, 0, 2)))
-    )
-
-    def step(state, ev_tm):
-        final = replay_scan(state, ev_tm)
-        return final, refresh_tasks_device(final)
-
-    step_jit = jax.jit(step)
+    T = events.shape[1]
     state0 = jax.device_put(
         jax.tree_util.tree_map(jnp.asarray, S.empty_state(batch, caps))
     )
-    jax.block_until_ready(state0)
-    jax.block_until_ready(step_jit(state0, events_tm))  # compile
+    state_bytes = sum(x.size * 4 for x in jax.tree_util.tree_leaves(state0))
+    ev_bytes_step = batch * S.EV_N * 4
 
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(step_jit(state0, events_tm))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    p50 = times[len(times) // 2]
-    device_rate = batch / p50
+    results = {}
 
-    # compiled-host baseline: C++ sequential replay of the same tensors
+    # ---- XLA scan kernel
+    ev_tm = jnp.asarray(np.ascontiguousarray(np.transpose(events, (1, 0, 2))))
+
+    def step_xla(state):
+        final = replay_scan(state, ev_tm)
+        return final, refresh_tasks_device(final)
+
+    dt, cs_xla = _time_chained(jax.jit(step_xla), state0, iters)
+    results["xla"] = {
+        "histories_per_sec": round(batch / dt, 2),
+        "batch_rebuild_ms": round(dt * 1000, 3),
+        "us_per_step": round(dt / T * 1e6, 3),
+        # state read+write + event row read, per scan step
+        "streams_gbps": round(
+            (2 * state_bytes + ev_bytes_step) / (dt / T) / 1e9, 1),
+    }
+    del ev_tm
+
+    # ---- Pallas kernel (field-major events + host presence masks)
+    if use_pallas:
+        ev_teb = jnp.asarray(
+            np.ascontiguousarray(np.transpose(events, (1, 2, 0))))
+        valid = events[:, :, S.EV_TYPE] >= 0
+        pres = None
+        if batch % bt == 0:
+            pres = jnp.asarray(presence_masks(
+                events[valid], valid.sum(axis=1).astype(np.int64), T, bt))
+
+        def step_pallas(state):
+            final = replay_scan_pallas_teb(
+                state, ev_teb, caps, tb=tb, interpret=False, bt=bt,
+                presence=pres)
+            return final, refresh_tasks_device(final)
+
+        try:
+            dt_p, cs_p = _time_chained(jax.jit(step_pallas), state0, iters)
+            if cs_p != cs_xla:
+                results["pallas"] = {"error": "checksum mismatch vs xla"}
+            else:
+                results["pallas"] = {
+                    "histories_per_sec": round(batch / dt_p, 2),
+                    "batch_rebuild_ms": round(dt_p * 1000, 3),
+                    "us_per_step": round(dt_p / T * 1e6, 3),
+                    # events are the only per-step HBM traffic (state is
+                    # VMEM-resident); final state flush is amortized
+                    "streams_gbps": round(ev_bytes_step / (dt_p / T) / 1e9, 1),
+                }
+        except Exception as exc:  # compile/runtime failure is a reportable
+            results["pallas"] = {
+                "error": f"{type(exc).__name__}: {str(exc)[:160]}"}
+
+    # ---- compiled-host baseline: C++ sequential replay of the same tensors
     class _Sub:
         pass
 
@@ -130,7 +226,20 @@ def _bench_config(config: str, caps, batch: int, iters: int,
     cpp_s = (time.perf_counter() - t0) / reps
     cpp_rate = nb / cpp_s
 
-    return device_rate, cpp_rate, mean_depth, p50 * 1000.0
+    best_key = "pallas" if (
+        "pallas" in results and "histories_per_sec" in results["pallas"]
+    ) else "xla"
+    best = results[best_key]
+    return {
+        "histories_per_sec": best["histories_per_sec"],
+        "kernel": best_key,
+        "baseline_cpp_per_sec": round(cpp_rate, 2),
+        "vs_baseline": round(best["histories_per_sec"] / cpp_rate, 2),
+        "mean_depth": round(mean_depth, 1),
+        "batch_rebuild_ms": best["batch_rebuild_ms"],
+        "batch": batch,
+        "kernels": results,
+    }
 
 
 def main() -> None:
@@ -142,11 +251,15 @@ def main() -> None:
         return
 
     on_cpu = jax.default_backend() == "cpu"
-    scale = 1 if on_cpu else 16
-    iters = 3 if on_cpu else 10
+    # the Pallas kernel needs the real chip; interpret mode is a test
+    # vehicle, not a benchmark
+    use_pallas = not on_cpu
+    scale = 1 if on_cpu else 128
+    iters = 3 if on_cpu else 5
+    bt, tb = 8192, 16
 
     # per-config capacities: sized to the workload (slot tables directly
-    # set HBM bytes/step — the scan is memory-bound on the state carry)
+    # set HBM bytes/step for the XLA kernel and VMEM rows for Pallas)
     CONFIGS = {
         "echo": dict(
             caps=S.Capacities(max_events=16, max_activities=2, max_timers=2,
@@ -157,46 +270,45 @@ def main() -> None:
             caps=S.Capacities(max_events=512, max_activities=2, max_timers=2,
                               max_children=2, max_request_cancels=2,
                               max_signals_ext=4, max_version_items=2),
-            batch=64 * scale, baseline=512),
+            batch=512 * scale, baseline=512),
         "timer_storm": dict(
             caps=S.Capacities(max_events=512, max_activities=2, max_timers=16,
                               max_children=2, max_request_cancels=2,
                               max_signals_ext=2, max_version_items=2),
-            batch=64 * scale, baseline=512),
+            batch=512 * scale, baseline=512),
         "retry_deep": dict(
             caps=S.Capacities(max_events=1024, max_activities=4, max_timers=2,
                               max_children=2, max_request_cancels=2,
                               max_signals_ext=2, max_version_items=2),
-            batch=32 * scale, baseline=256),
+            batch=512 * scale, baseline=256),
         "ndc_storm": dict(
             caps=S.Capacities(max_events=1024),  # full default tables
-            batch=32 * scale, baseline=256),
+            batch=256 * scale, baseline=256),
     }
+
+    copy_bw = measure_copy_bw_gbps() if not on_cpu else None
 
     results = {}
     for config, cfg in CONFIGS.items():
-        dev, cpp, depth, p50_ms = _bench_config(
-            config, cfg["caps"], cfg["batch"], iters, cfg["baseline"])
-        results[config] = {
-            "histories_per_sec": round(dev, 2),
-            "baseline_cpp_per_sec": round(cpp, 2),
-            "vs_baseline": round(dev / cpp, 2),
-            "mean_depth": round(depth, 1),
-            "p50_batch_rebuild_ms": round(p50_ms, 3),
-            "batch": cfg["batch"],
-        }
+        results[config] = _bench_config(
+            config, cfg["caps"], cfg["batch"], iters, cfg["baseline"],
+            bt, tb, use_pallas)
 
     head = results["retry_deep"]
-    print(json.dumps({
+    out = {
         "metric": "histories_replayed_per_sec_at_1k_depth",
         "value": head["histories_per_sec"],
         "unit": "histories/s",
         "vs_baseline": head["vs_baseline"],
         "baseline": "native C++ -O3 sequential replayer (same semantics, same data)",
-        "p50_rebuild_ms_per_1k_history": round(
-            head["p50_batch_rebuild_ms"] / head["batch"], 4),
+        "kernel": head["kernel"],
+        "batch_rebuild_ms_per_1k_history": round(
+            head["batch_rebuild_ms"] / head["batch"], 4),
         "configs": results,
-    }))
+    }
+    if copy_bw is not None:
+        out["copy_bw_gbps"] = round(copy_bw, 1)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -369,9 +369,27 @@ def replay_packed(
     packed: PackedHistories,
     initial: Optional[S.StateTensors] = None,
 ) -> S.StateTensors:
-    """Replay a packed batch on the default device; returns numpy state."""
+    """Replay a packed batch on the default device; returns numpy state.
+
+    On TPU this rides the Pallas VMEM-resident kernel through the
+    packer's field-major layout + host presence masks (the serving-path
+    configuration bench.py measures); elsewhere it uses the XLA scan —
+    the two are bit-identical (tests/test_replay_pallas.py)."""
     state = initial if initial is not None else S.empty_state(packed.batch, packed.caps)
     state = jax.tree_util.tree_map(jnp.asarray, state)
-    events_tm = jnp.asarray(packed.time_major())
-    final = replay_scan_jit(state, events_tm)
+    if packed.batch == 0:
+        return jax.tree_util.tree_map(np.asarray, state)
+    if jax.default_backend() == "tpu":
+        from .replay_pallas import BT, replay_scan_pallas_teb
+
+        # smallest whole tile covering the batch (small rebuild batches
+        # shouldn't pad to the full throughput tile)
+        bt = min(BT, ((packed.batch + 1023) // 1024) * 1024)
+        final = replay_scan_pallas_teb(
+            state, jnp.asarray(packed.teb()), packed.caps,
+            interpret=False, bt=bt, presence=packed.presence(bt),
+        )
+    else:
+        events_tm = jnp.asarray(packed.time_major())
+        final = replay_scan_jit(state, events_tm)
     return jax.tree_util.tree_map(np.asarray, final)

@@ -148,10 +148,12 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int,
 
     The batch tile is shaped (SL, 128) with SL a multiple of 8 — whole
     int32 VPU tiles — so every row update runs at full sublane x lane
-    utilization (a flat [BT] row would occupy 1 of 8 sublanes). Larger
-    SL amortizes per-instruction overhead of the sequential time loop
-    over more lanes (the step cost is dominated by instruction issue,
-    not data): SL=32 measures ~2.5x the events/s of SL=8 on v5e.
+    utilization (a flat [BT] row would occupy 1 of 8 sublanes). With
+    forced-materialization timing the kernel is bound by streaming the
+    event blocks from HBM, not by the step body: an empty-body ablation
+    (ablate=5) measures the same wall time as the full FSM at B=65536
+    (scripts/probe4.py, v5e, 2026-07), so SL mainly trades VMEM for
+    fewer grid steps; bt=8192 (SL=64) measured best.
 
     presence_ref: [1, TB, 4] SMEM — per-step scalar gates for this
              tile: words 0-1 are the event-type bitmask (bit e of word

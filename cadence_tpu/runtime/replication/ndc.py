@@ -87,7 +87,15 @@ class NDCHistoryReplicator:
 
     # -- entry point ---------------------------------------------------
 
-    def apply_events(self, task: HistoryTaskV2) -> None:
+    def apply_events(
+        self, task: HistoryTaskV2, _defer_rebuild: bool = False,
+    ) -> Optional[dict]:
+        """Apply one replication task.
+
+        With ``_defer_rebuild`` (the batched drain), a task whose apply
+        requires a conflict rebuild is NOT rebuilt inline; a plan record
+        is returned instead so the caller can rebuild many workflows in
+        one device scan (``apply_events_batch``)."""
         if not task.events:
             raise ValueError("replication task has no events")
         ctx = self.cache.get_or_create(
@@ -98,8 +106,94 @@ class NDCHistoryReplicator:
                 ms = ctx.load()
             except EntityNotExistsError:
                 self._apply_for_new_workflow(ctx, task)
+                return None
+            return self._apply_for_existing(
+                ctx, ms, task, _defer_rebuild=_defer_rebuild
+            )
+
+    def apply_events_batch(self, tasks) -> None:
+        """Batched drain: apply a fetched cycle's tasks, routing every
+        conflict rebuild through ONE ``rebuild_many`` device scan.
+
+        Matches the reference's per-task semantics
+        (replicationTaskProcessor.go:85-434 feeding
+        nDCConflictResolver.go:65) — a replication storm that forces N
+        workflows to rebuild at a branch point replays all N histories
+        as one batched scan instead of N sequential host replays. Once a
+        workflow defers, ALL its later tasks in the cycle — any run of
+        the same workflow_id, matching the reference's per-workflow
+        sequential ordering (common/task/sequentialTaskProcessor.go) —
+        queue behind the rebuild and apply, in order, after it
+        completes."""
+        deferred: dict = {}
+        order: list = []
+        barrier: dict = {}   # (domain, wf) -> deferred key
+        for task in tasks:
+            wf_key = (task.domain_id, task.workflow_id)
+            if wf_key in barrier:
+                deferred[barrier[wf_key]]["followups"].append(task)
+                continue
+            rec = self.apply_events(task, _defer_rebuild=True)
+            if rec is not None:
+                key = (task.domain_id, task.workflow_id, task.run_id)
+                deferred[key] = rec
+                order.append(key)
+                barrier[wf_key] = key
+        if not deferred:
+            return
+        reqs = [
+            RebuildRequest(
+                domain_id=deferred[k]["task"].domain_id,
+                workflow_id=deferred[k]["task"].workflow_id,
+                run_id=deferred[k]["task"].run_id,
+                branch_token=deferred[k]["branch_token"],
+                next_event_id=deferred[k]["next_event_id"],
+            )
+            for k in order
+        ]
+        rebuilt = self.rebuilder.rebuild_many(reqs, use_device=True)
+        for k, (ms, _, _) in zip(order, rebuilt):
+            rec = deferred[k]
+            self._finish_deferred_rebuild(rec, ms)
+            for t in rec["followups"]:
+                self.apply_events(t)
+
+    def _finish_deferred_rebuild(self, rec: dict, rebuilt) -> None:
+        task, bi = rec["task"], rec["branch_index"]
+        # re-fetch the context: the plan-time handle may have been
+        # evicted from the cache between planning and completion (e.g.
+        # a SUPPRESS_CURRENT create zombifying this run) and would then
+        # serve a stale cached mutable state
+        ctx = self.cache.get_or_create(
+            task.domain_id, task.workflow_id, task.run_id
+        )
+        with ctx.lock:
+            ms = ctx.load()
+            local = ms.version_histories
+            # re-validate the plan under the lock (the replication pump
+            # is the shard's single writer, but anything may have moved
+            # between planning and completion); on any drift fall back
+            # to the inline path
+            plan_holds = (
+                local is not None
+                and bi < len(local.histories)
+                and local.current_index != bi
+                and local.get_version_history(bi).branch_token
+                == rec["branch_token"]
+                and local.get_version_history(bi).last_item().event_id + 1
+                == rec["next_event_id"]
+                and task.version
+                > local.get_current_version_history().last_item().version
+            )
+            if not plan_holds:
+                self._apply_for_existing(ctx, ms, task)
                 return
-            self._apply_for_existing(ctx, ms, task)
+            target_vh = local.get_version_history(bi)
+            rebuilt.version_histories = local
+            local.current_index = bi
+            rebuilt.execution_info.run_id = task.run_id
+            rebuilt.execution_info.workflow_id = task.workflow_id
+            self._apply_to_current(ctx, rebuilt, task, target_vh)
 
     # -- creation path (nDCTransactionMgrForNewWorkflow) ---------------
 
@@ -181,8 +275,9 @@ class NDCHistoryReplicator:
     # -- existing-workflow path ----------------------------------------
 
     def _apply_for_existing(
-        self, ctx, ms: MutableState, task: HistoryTaskV2
-    ) -> None:
+        self, ctx, ms: MutableState, task: HistoryTaskV2,
+        _defer_rebuild: bool = False,
+    ) -> Optional[dict]:
         local = ms.version_histories
         if local is None:
             raise ValueError(
@@ -212,7 +307,7 @@ class NDCHistoryReplicator:
                     VersionHistoryItem(task.next_event_id - 1, task.version)
                 )
             ):
-                return  # duplicate batch — already applied
+                return None  # duplicate batch — already applied
             if task.first_event_id > last_local.event_id + 1:
                 raise RetryTaskV2Error(
                     "missing intermediate events",
@@ -245,15 +340,25 @@ class NDCHistoryReplicator:
         # conflict resolution: which branch becomes/stays current
         if branch_index == local.current_index:
             self._apply_to_current(ctx, ms, task, branch_vh)
-            return
+            return None
 
         current_vh = local.get_current_version_history()
         if task.version > current_vh.last_item().version:
             # incoming wins: rebuild state from the target branch tip,
             # then continue applying on it as the new current
+            target_vh = local.get_version_history(branch_index)
+            if _defer_rebuild:
+                return {
+                    "task": task,
+                    "branch_index": branch_index,
+                    "branch_token": target_vh.branch_token,
+                    "next_event_id": target_vh.last_item().event_id + 1,
+                    "followups": [],
+                }
             self._rebuild_and_apply(ctx, ms, task, branch_index)
         else:
             self._backfill_branch(ctx, ms, task, branch_index)
+        return None
 
     # -- branch manager ------------------------------------------------
 

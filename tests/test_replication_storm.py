@@ -1,0 +1,280 @@
+"""Batched replication-storm drain: a fetch cycle whose conflict
+rebuilds collapse into ONE device scan.
+
+Reference semantics: replicationTaskProcessor.go:85-434 applies fetched
+tasks one at a time, each conflict resolving through
+nDCConflictResolver.go:65 → nDCStateRebuilder.rebuild (a sequential
+replay per workflow). The TPU-native drain plans the whole cycle first,
+then rebuilds every conflicted workflow in a single
+``StateRebuilder.rebuild_many`` batched replay — this file asserts the
+storm path (a) produces bit-identical mutable state to the
+one-at-a-time path and (b) actually goes through one batched rebuild,
+not N scalar ones.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import pytest
+
+from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.core import history_factory as F
+from cadence_tpu.ops.unpack import mutable_state_to_snapshot
+from cadence_tpu.runtime.domains import DomainCache, register_domain
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.replication import (
+    HistoryTaskV2,
+    ReplicationMessages,
+    ReplicationTaskFetcher,
+    ReplicationTaskProcessor,
+)
+from cadence_tpu.runtime.service import HistoryService
+
+SECOND = 1_000_000_000
+T0 = 1_700_000_000 * SECOND
+DOMAIN = "storm-domain"
+ACTIVE_V = 1
+STANDBY_V = 12
+
+
+class Box:
+    def __init__(self):
+        self.persistence = create_memory_bundle()
+        self.domain_id = register_domain(
+            self.persistence.metadata, DOMAIN, is_global=True,
+            clusters=["active", "standby"], active_cluster="active",
+            failover_version=ACTIVE_V,
+        )
+        self.domains = DomainCache(self.persistence.metadata)
+        self.history = HistoryService(
+            1, self.persistence, self.domains,
+            single_host_monitor("storm-host"),
+            cluster_metadata=ClusterMetadata(
+                failover_version_increment=10,
+                master_cluster_name="active",
+                current_cluster_name="standby",
+                cluster_info={
+                    "active": ClusterInformation(initial_failover_version=1),
+                    "standby": ClusterInformation(initial_failover_version=2),
+                },
+            ),
+        )
+        self.history_client = HistoryClient(self.history.controller)
+        self.matching = MatchingEngine(
+            self.persistence.task, self.history_client
+        )
+        self.history.wire(MatchingClient(self.matching), self.history_client)
+        self.history.start()
+        self.engine = self.history.controller.get_engine_for_shard(0)
+
+    def stop(self):
+        self.history.stop()
+        self.matching.shutdown()
+
+
+from cadence_tpu.matching import MatchingEngine  # noqa: E402
+
+
+def _storm_tasks(domain_id, n_workflows):
+    """3 tasks per workflow: seed x2 (creation + continuation), then a
+    divergent higher-version batch that forces a conflict rebuild."""
+    tasks = []
+    tid = 0
+    wfs = []
+    for i in range(n_workflows):
+        wf, run = f"wf-storm-{i}", f"run-storm-{i}"
+        wfs.append((wf, run))
+        b1 = [
+            F.workflow_execution_started(
+                1, ACTIVE_V, T0, task_list="tl", workflow_type="wt",
+                execution_start_to_close_timeout_seconds=300,
+                task_start_to_close_timeout_seconds=10,
+            ),
+            F.decision_task_scheduled(2, ACTIVE_V, T0),
+        ]
+        b2 = [F.decision_task_started(3, ACTIVE_V, T0 + SECOND,
+                                      scheduled_event_id=2)]
+        divergent = [
+            F.decision_task_started(3, STANDBY_V, T0 + 2 * SECOND,
+                                    scheduled_event_id=2)
+        ]
+        for items, events in (
+            ([{"event_id": 2, "version": ACTIVE_V}], b1),
+            ([{"event_id": 3, "version": ACTIVE_V}], b2),
+            ([{"event_id": 2, "version": ACTIVE_V},
+              {"event_id": 3, "version": STANDBY_V}], divergent),
+        ):
+            tid += 1
+            tasks.append(HistoryTaskV2(
+                task_id=tid, domain_id=domain_id, workflow_id=wf,
+                run_id=run, version_history_items=items, events=events,
+            ))
+    return tasks, wfs
+
+
+class _QueueClient:
+    """RemoteClusterClient serving a fixed task backlog in one cycle."""
+
+    def __init__(self, tasks):
+        self.tasks = tasks
+
+    def get_replication_messages(self, shard_id, last_retrieved_id):
+        pending = [t for t in self.tasks if t.task_id > last_retrieved_id]
+        last = pending[-1].task_id if pending else last_retrieved_id
+        return ReplicationMessages(tasks=pending, last_retrieved_id=last)
+
+
+def _snapshot_all(box, wfs):
+    out = {}
+    for wf, run in wfs:
+        ctx = box.engine.cache.get_or_create(box.domain_id, wf, run)
+        with ctx.lock:
+            ctx.clear()
+            ms = ctx.load()
+        snap = mutable_state_to_snapshot(ms)
+        vhs = ms.version_histories.to_dict()
+        for h in vhs["histories"]:   # branch ids are random uuids
+            h.pop("branch_token", None)
+        out[wf] = (snap, vhs)
+    return out
+
+
+def _run_storm(n_workflows, record=None):
+    """Drain a storm through the batched processor; returns snapshots."""
+    box = Box()
+    try:
+        tasks, wfs = _storm_tasks(box.domain_id, n_workflows)
+        fetcher = ReplicationTaskFetcher("active", _QueueClient(tasks))
+        proc = ReplicationTaskProcessor(
+            self_shard(box), box.engine.ndc_replicator, fetcher
+        )
+        if record is not None:
+            rb = box.engine.ndc_replicator.rebuilder
+            orig_many, orig_one = rb.rebuild_many, rb.rebuild
+
+            def spy_many(reqs, use_device=True):
+                record.append(("many", len(reqs), use_device))
+                return orig_many(reqs, use_device=use_device)
+
+            def spy_one(req):
+                record.append(("one", 1, False))
+                return orig_one(req)
+
+            rb.rebuild_many, rb.rebuild = spy_many, spy_one
+        applied = proc.drain()
+        assert applied == len(tasks)
+        return _snapshot_all(box, wfs)
+    finally:
+        box.stop()
+
+
+def _run_sequential(n_workflows):
+    """One-at-a-time reference path: apply_events per task (inline
+    scalar rebuilds)."""
+    box = Box()
+    try:
+        tasks, wfs = _storm_tasks(box.domain_id, n_workflows)
+        for t in tasks:
+            box.engine.ndc_replicator.apply_events(t)
+        return _snapshot_all(box, wfs)
+    finally:
+        box.stop()
+
+
+def self_shard(box):
+    return box.engine.shard
+
+
+def test_storm_batched_matches_sequential():
+    record = []
+    got = _run_storm(24, record=record)
+    want = _run_sequential(24)
+    assert got == want
+    # every conflict rebuild rode ONE batched call; no scalar rebuilds
+    many = [r for r in record if r[0] == "many"]
+    assert many == [("many", 24, True)]
+
+
+def test_cross_run_tasks_queue_behind_deferred_rebuild():
+    """A cycle carrying [conflict for run R1, creation of run R2 of the
+    SAME workflow] must apply in order: R2's create-mode decision reads
+    R1's post-rebuild last_write_version. The batch path queues any
+    same-workflow task behind the deferred rebuild (per-workflow
+    ordering, ref common/task/sequentialTaskProcessor.go)."""
+
+    def build(box):
+        tasks, wfs = _storm_tasks(box.domain_id, 1)   # wf with run R1
+        (wf, r1) = wfs[0]
+        r2 = "run-storm-0-bis"
+        b1 = [
+            F.workflow_execution_started(
+                1, STANDBY_V, T0 + 3 * SECOND, task_list="tl",
+                workflow_type="wt",
+                execution_start_to_close_timeout_seconds=300,
+                task_start_to_close_timeout_seconds=10,
+            ),
+            F.decision_task_scheduled(2, STANDBY_V, T0 + 3 * SECOND),
+        ]
+        tasks.append(HistoryTaskV2(
+            task_id=len(tasks) + 1, domain_id=box.domain_id,
+            workflow_id=wf, run_id=r2,
+            version_history_items=[{"event_id": 2, "version": STANDBY_V}],
+            events=b1,
+        ))
+        return tasks, [(wf, r1), (wf, r2)]
+
+    def current_run(box, wf):
+        return box.persistence.execution.get_current_execution(
+            0, box.domain_id, wf
+        ).run_id
+
+    # batched
+    box = Box()
+    try:
+        tasks, runs = build(box)
+        fetcher = ReplicationTaskFetcher("active", _QueueClient(tasks))
+        ReplicationTaskProcessor(
+            self_shard(box), box.engine.ndc_replicator, fetcher
+        ).drain()
+        got = {run: _snapshot_all(box, [(wf, run)]) for wf, run in runs}
+        got_current = current_run(box, runs[0][0])
+    finally:
+        box.stop()
+
+    # sequential reference
+    box = Box()
+    try:
+        tasks, runs = build(box)
+        for t in tasks:
+            box.engine.ndc_replicator.apply_events(t)
+        want = {run: _snapshot_all(box, [(wf, run)]) for wf, run in runs}
+        want_current = current_run(box, runs[0][0])
+    finally:
+        box.stop()
+
+    assert got == want
+    assert (got_current == runs[0][1]) == (want_current == runs[0][1])
+
+
+@pytest.mark.slow
+def test_storm_10k_few_scans():
+    """VERDICT r3 task 3 'done' criterion: a >=10k-task storm drains
+    through few device scans (one batched rebuild per pump cycle)."""
+    n = 3334  # 3 tasks each -> 10,002 tasks in one fetch cycle
+    record = []
+    got = _run_storm(n, record=record)
+    many = [r for r in record if r[0] == "many"]
+    ones = [r for r in record if r[0] == "one"]
+    assert many == [("many", n, True)]
+    assert not ones
+    # spot-check a sample against the sequential path would double the
+    # runtime; state identity at scale is covered by the 24-workflow
+    # case plus kernel differential tests — here assert the storm
+    # actually closed every workflow's conflict
+    for wf, (snap, vhs) in got.items():
+        assert snap["exec"]["dec_started_id"] == 3
+        assert vhs["histories"][vhs["current_index"]]["items"][-1] == [
+            3, STANDBY_V]
