@@ -7,6 +7,7 @@ machinery."""
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from typing import Dict, List, Optional
@@ -28,18 +29,51 @@ class DomainCache:
         self._by_id: Dict[str, DomainRecord] = {}
         self._by_name: Dict[str, DomainRecord] = {}
         self._version = -1
+        self._failover_listeners: List = []
+        # active-cluster snapshot per domain, taken at refresh time —
+        # records can be mutated in place by callers, so the comparison
+        # baseline must be the immutable string captured at insert
+        self._active_cluster: Dict[str, str] = {}
+
+    def add_failover_listener(self, fn) -> None:
+        """fn(domain_id, old_active_cluster, new_active_cluster) — fired
+        when a refresh observes a domain's active cluster change (ref
+        domainCache.go RegisterDomainChangeCallback driving the queue
+        processors' failover handling)."""
+        with self._lock:
+            self._failover_listeners.append(fn)
 
     def _refresh_if_stale(self) -> None:
         v = self.metadata.get_metadata_version()
+        failovers = []
         with self._lock:
             if v == self._version:
                 return
+            old_active = self._active_cluster
+            self._active_cluster = {}
             self._by_id.clear()
             self._by_name.clear()
             for rec in self.metadata.list_domains():
                 self._by_id[rec.info.id] = rec
                 self._by_name[rec.info.name] = rec
+                new_cluster = rec.replication_config.active_cluster_name
+                self._active_cluster[rec.info.id] = new_cluster
+                old_cluster = old_active.get(rec.info.id)
+                if old_cluster is not None and old_cluster != new_cluster:
+                    failovers.append((rec.info.id, old_cluster, new_cluster))
             self._version = v
+            listeners = list(self._failover_listeners)
+        for domain_id, old_cluster, new_cluster in failovers:
+            for fn in listeners:
+                try:
+                    fn(domain_id, old_cluster, new_cluster)
+                except Exception:
+                    # the version transition is one-shot; a lost rewind
+                    # must at least be visible
+                    logging.getLogger("cadence_tpu.domains").exception(
+                        "failover listener failed for domain %s (%s->%s)",
+                        domain_id, old_cluster, new_cluster,
+                    )
 
     def get_by_id(self, domain_id: str) -> DomainRecord:
         self._refresh_if_stale()

@@ -13,15 +13,23 @@ and retries work items.
 
 from .ack import QueueAckManager
 from .base import QueueProcessorBase
+from .standby import (
+    QueueGC,
+    TimerQueueStandbyProcessor,
+    TransferQueueStandbyProcessor,
+)
 from .timer import TimerQueueProcessor
 from .timer_gate import LocalTimerGate, RemoteTimerGate
 from .transfer import TransferQueueProcessor
 
 __all__ = [
     "QueueAckManager",
+    "QueueGC",
     "QueueProcessorBase",
     "TimerQueueProcessor",
+    "TimerQueueStandbyProcessor",
     "LocalTimerGate",
     "RemoteTimerGate",
     "TransferQueueProcessor",
+    "TransferQueueStandbyProcessor",
 ]

@@ -24,9 +24,11 @@ class QueueAckManager:
         self._update_shard_ack = update_shard_ack
 
     def add(self, key) -> bool:
-        """Register a read task; False if already outstanding (dup read)."""
+        """Register a read task; False if already outstanding (dup read)
+        or already acked (a completed frontier row re-read because queue
+        GC deletes exclusively below the ack level)."""
         with self._lock:
-            if key in self._outstanding:
+            if key in self._outstanding or key <= self.ack_level:
                 return False
             self._outstanding[key] = False
             if key > self.read_level:
@@ -52,6 +54,26 @@ class QueueAckManager:
         if level != before and self._update_shard_ack is not None:
             self._update_shard_ack(level)
         return level
+
+    def rewind(self, level) -> None:
+        """Move the cursor back to ``level`` (failover reprocessing: the
+        new active side re-reads from the standby cursor; verification-
+        based handlers make re-execution idempotent)."""
+        with self._lock:
+            if level >= self.ack_level:
+                return
+            self.ack_level = level
+            if level < self.read_level:
+                self.read_level = level
+            # completed-but-unswept entries above the rewound level must
+            # not let update_ack_level jump straight back over the span
+            # being re-verified
+            for key in [k for k in self._outstanding if k > level]:
+                del self._outstanding[key]
+        # persist immediately: a restart re-initializes from the shard
+        # cursor, and the failover event will not re-fire
+        if self._update_shard_ack is not None:
+            self._update_shard_ack(level)
 
     def set_read_level(self, level) -> None:
         with self._lock:

@@ -18,18 +18,24 @@ class TaskAllocator:
     def should_process(self, domain_id: str) -> bool:
         """True if the task's domain is active here (or local-only, or
         the cluster is single-cluster)."""
+        return self.owning_cluster(domain_id) is None
+
+    def owning_cluster(self, domain_id: str) -> "str | None":
+        """None when the task's domain is active here; otherwise the
+        remote cluster the domain is active in (whose standby plane —
+        if one runs here — owns the task)."""
         if self.cluster_metadata is None:
-            return True
+            return None
         try:
             rec = self.domains.get_by_id(domain_id)
         except Exception:
-            return True  # unknown domain: let the handler surface it
+            return None  # unknown domain: let the handler surface it
         if not rec.is_global:
-            return True
-        return (
-            rec.replication_config.active_cluster_name
-            == self.cluster_metadata.current_cluster_name
-        )
+            return None
+        active = rec.replication_config.active_cluster_name
+        if active == self.cluster_metadata.current_cluster_name:
+            return None
+        return active
 
 
 class DeferTask(Exception):

@@ -1,0 +1,39 @@
+"""Retention-driven workflow deletion, shared by the active and standby
+timer pipelines (ref timerQueueProcessorBase.go deleteHistoryEvent —
+retention runs on every cluster)."""
+
+from __future__ import annotations
+
+
+def delete_workflow_retention(shard, engine, task) -> None:
+    """Remove visibility, mutable state, and the history branch of a
+    retention-expired run; idempotent (a second call finds nothing)."""
+    ex = shard.persistence.execution
+    vis = shard.persistence.visibility
+    hist = shard.persistence.history
+    try:
+        record = ex.get_workflow_execution(
+            shard.shard_id, task.domain_id, task.workflow_id, task.run_id,
+        )
+    except Exception:
+        return  # already gone
+    if vis is not None:
+        try:
+            vis.delete_workflow_execution(
+                task.domain_id, task.workflow_id, task.run_id
+            )
+        except Exception:
+            pass
+    branch = record.snapshot.get("execution_info", {}).get("branch_token", b"")
+    ex.delete_current_workflow_execution(
+        shard.shard_id, task.domain_id, task.workflow_id, task.run_id
+    )
+    ex.delete_workflow_execution(
+        shard.shard_id, task.domain_id, task.workflow_id, task.run_id
+    )
+    if branch and hist is not None:
+        try:
+            hist.delete_history_branch(branch)
+        except Exception:
+            pass
+    engine.cache.evict(task.domain_id, task.workflow_id, task.run_id)

@@ -1,0 +1,544 @@
+"""Standby queue processors: verify-and-discharge for passive domains.
+
+Reference: service/history/transferQueueStandbyProcessor.go and
+timerQueueStandbyProcessor.go — each remote cluster gets a standby
+variant of the transfer/timer pipelines with its own persisted ack
+cursor. A standby processor never executes a task's active side effect
+(no matching pushes, no timeout events); it *verifies* the task against
+the replicated state:
+
+  * the state shows replication already delivered the outcome (decision
+    started, activity closed, timer fired, workflow closed) → the task
+    is discharged and the standby cursor advances;
+  * the outcome hasn't replicated yet → the task is held and re-read
+    after a standby delay (``DeferTask``), converging when replication
+    catches up (the rereplication path heals gaps);
+  * side effects that DO belong on the standby side run here: visibility
+    records (started/closed/upsert) and retention-driven deletion.
+
+Timer standby fires against the REMOTE cluster's clock
+(``RemoteTimerGate`` advanced by the replication stream's
+``source_time_ns``), mirroring timerGate.go:164 — a standby cluster
+whose local clock runs ahead must not judge a remote timer "due" before
+the owning cluster would.
+
+Failover: the processors are verification-based and idempotent, so the
+active side takes over lost ground by rewinding its cursor to the
+standby cursor (``QueueAckManager.rewind``) when a domain fails over to
+this cluster — re-reading the span the active processor had skipped as
+passive (ref transferQueueProcessor.go failover processor).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from cadence_tpu.core.enums import TimerTaskType, TransferTaskType, WorkflowState
+from cadence_tpu.core.ids import EMPTY_EVENT_ID
+from cadence_tpu.core.tasks import TimerTask, TransferTask
+from cadence_tpu.core.timer_sequence import TimerSequence
+from cadence_tpu.runtime.api import EntityNotExistsServiceError
+from cadence_tpu.runtime.persistence.records import VisibilityRecord
+from cadence_tpu.utils.log import get_logger
+
+from .ack import QueueAckManager
+from .allocator import DeferTask, defer_task
+from .base import QueueProcessorBase
+from .timer_gate import RemoteTimerGate
+
+
+class QueueGC:
+    """Range-deletes task rows below the MINIMUM ack level across the
+    active processor and every standby cursor (ref
+    transferQueueProcessor.go completeTransferLoop /
+    timerQueueProcessor.go completeTimersLoop). Owns deletion whenever
+    standby planes share the task stream — per-task deletes would starve
+    the slower cursor."""
+
+    def __init__(
+        self,
+        shard,
+        transfer_active,
+        timer_active,
+        standby_clusters,
+        interval_s: float = 0.1,
+    ) -> None:
+        self.shard = shard
+        self.transfer_active = transfer_active
+        self.timer_active = timer_active
+        self.standby_clusters = list(standby_clusters)
+        self._interval = interval_s
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"queue-gc-{shard.shard_id}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def notify(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        self.collect()
+        return True
+
+    def collect(self) -> None:
+        transfer_min = min(
+            [self.transfer_active.ack.ack_level]
+            + [
+                self.shard.get_cluster_transfer_ack_level(c)
+                for c in self.standby_clusters
+            ]
+        )
+        if transfer_min > 0:
+            self.shard.persistence.execution.range_complete_transfer_tasks(
+                self.shard.shard_id, 0, transfer_min
+            )
+        timer_min = min(
+            [self.timer_active.ack.ack_level[0]]
+            + [
+                self.shard.get_cluster_timer_ack_level(c)
+                for c in self.standby_clusters
+            ]
+        )
+        if timer_min > 0:
+            self.shard.persistence.execution.range_complete_timer_tasks(
+                self.shard.shard_id, 0, timer_min
+            )
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval):
+            try:
+                self.collect()
+            except Exception:
+                pass
+
+
+class _StandbyAllocator:
+    """Owns a task iff its domain is ACTIVE in ``cluster`` (i.e. this
+    cluster stands by for it)."""
+
+    def __init__(self, domains, cluster: str) -> None:
+        self.domains = domains
+        self.cluster = cluster
+
+    def owns(self, domain_id: str) -> bool:
+        try:
+            rec = self.domains.get_by_id(domain_id)
+        except Exception:
+            return False
+        if not rec.is_global:
+            return False
+        return rec.replication_config.active_cluster_name == self.cluster
+
+
+class TransferQueueStandbyProcessor(QueueProcessorBase):
+    """Transfer standby variant for one remote cluster."""
+
+    def __init__(
+        self,
+        shard,
+        engine,
+        cluster: str,
+        visibility=None,
+        worker_count: int = 2,
+        batch_size: int = 64,
+    ) -> None:
+        self.shard = shard
+        self.engine = engine
+        self.cluster = cluster
+        self.visibility = (
+            visibility if visibility is not None
+            else shard.persistence.visibility
+        )
+        self._slog = get_logger(
+            "cadence_tpu.queue.transfer-standby",
+            shard=shard.shard_id, cluster=cluster,
+        )
+        self._allocator = _StandbyAllocator(engine.domains, cluster)
+        ack = QueueAckManager(
+            shard.get_cluster_transfer_ack_level(cluster),
+            update_shard_ack=lambda lvl: shard.update_cluster_transfer_ack_level(
+                cluster, lvl
+            ),
+        )
+        super().__init__(
+            name=f"transfer-standby-{cluster}-{shard.shard_id}",
+            ack=ack,
+            read_batch=lambda level, n: shard.persistence.execution.get_transfer_tasks(
+                shard.shard_id, level, 2**62, n
+            ),
+            process_task=self._process,
+            # the ACTIVE processor owns task-row deletion; standby only
+            # advances its own cursor
+            complete_task=lambda t: None,
+            task_key=lambda t: t.task_id,
+            worker_count=worker_count,
+            batch_size=batch_size,
+        )
+
+    # -- verification dispatch ----------------------------------------
+
+    def _process(self, task: TransferTask) -> None:
+        if not self._allocator.owns(task.domain_id):
+            return  # locally-active (or other-cluster) task: not ours
+        handler = {
+            TransferTaskType.DecisionTask: self._verify_decision,
+            TransferTaskType.ActivityTask: self._verify_activity,
+            TransferTaskType.CloseExecution: self._verify_close,
+            TransferTaskType.CancelExecution: self._verify_cancel,
+            TransferTaskType.SignalExecution: self._verify_signal,
+            TransferTaskType.StartChildExecution: self._verify_start_child,
+            TransferTaskType.RecordWorkflowStarted: self._record_started,
+            TransferTaskType.UpsertWorkflowSearchAttributes: self._upsert,
+            TransferTaskType.ResetWorkflow: lambda t: None,
+        }.get(task.task_type)
+        if handler is None:
+            return
+        handler(task)
+
+    def _read(self, task, reader):
+        try:
+            return self.engine.with_workflow(
+                task.domain_id, task.workflow_id, task.run_id,
+                lambda ctx, ms: reader(ms),
+            )
+        except EntityNotExistsServiceError:
+            return None  # workflow gone: task verified trivially
+
+    def _verify_decision(self, task: TransferTask) -> None:
+        # done once replication shows the decision started (or moved on)
+        def read(ms):
+            ei = ms.execution_info
+            return (
+                ms.has_pending_decision()
+                and ei.decision_schedule_id == task.schedule_id
+                and ei.decision_started_id == EMPTY_EVENT_ID
+            )
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_activity(self, task: TransferTask) -> None:
+        def read(ms):
+            ai = ms.get_activity_info(task.schedule_id)
+            return ai is not None and ai.started_id == EMPTY_EVENT_ID
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_close(self, task: TransferTask) -> None:
+        # standby records closed visibility once the close replicated
+        def read(ms):
+            if ms.is_workflow_execution_running():
+                return "running"
+            ei = ms.execution_info
+            return VisibilityRecord(
+                domain_id=task.domain_id,
+                workflow_id=task.workflow_id,
+                run_id=task.run_id,
+                workflow_type=ei.workflow_type_name,
+                start_time=ei.start_timestamp,
+                close_time=ei.last_updated_timestamp or self.shard.now(),
+                close_status=int(ei.close_status),
+                history_length=ms.next_event_id - 1,
+                memo=dict(ei.memo),
+                search_attributes=dict(ei.search_attributes),
+            )
+
+        rec = self._read(task, read)
+        if rec == "running":
+            raise DeferTask(task.domain_id)
+        if rec is not None and self.visibility is not None:
+            self.visibility.record_workflow_execution_closed(rec)
+
+    def _verify_cancel(self, task: TransferTask) -> None:
+        def read(ms):
+            return ms.get_request_cancel_info(task.initiated_id) is not None
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_signal(self, task: TransferTask) -> None:
+        def read(ms):
+            return ms.get_signal_info(task.initiated_id) is not None
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_start_child(self, task: TransferTask) -> None:
+        def read(ms):
+            ci = ms.get_child_execution_info(task.initiated_id)
+            return ci is not None and ci.started_id == EMPTY_EVENT_ID
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _record_started(self, task: TransferTask) -> None:
+        def read(ms):
+            ei = ms.execution_info
+            return VisibilityRecord(
+                domain_id=task.domain_id,
+                workflow_id=task.workflow_id,
+                run_id=task.run_id,
+                workflow_type=ei.workflow_type_name,
+                start_time=ei.start_timestamp,
+                execution_time=ei.start_timestamp,
+                memo=dict(ei.memo),
+                search_attributes=dict(ei.search_attributes),
+            )
+
+        rec = self._read(task, read)
+        if rec is not None and self.visibility is not None:
+            self.visibility.record_workflow_execution_started(rec)
+
+    def _upsert(self, task: TransferTask) -> None:
+        rec = self._read(task, lambda ms: VisibilityRecord(
+            domain_id=task.domain_id,
+            workflow_id=task.workflow_id,
+            run_id=task.run_id,
+            workflow_type=ms.execution_info.workflow_type_name,
+            start_time=ms.execution_info.start_timestamp,
+            execution_time=ms.execution_info.start_timestamp,
+            memo=dict(ms.execution_info.memo),
+            search_attributes=dict(ms.execution_info.search_attributes),
+        ))
+        if rec is not None and self.visibility is not None:
+            self.visibility.upsert_workflow_execution(rec)
+
+
+class TimerQueueStandbyProcessor:
+    """Timer standby variant for one remote cluster: fires against the
+    remote cluster's clock, verifies outcomes against replicated state."""
+
+    _TASK_RETRY_COUNT = 3
+
+    def __init__(
+        self,
+        shard,
+        engine,
+        cluster: str,
+        worker_count: int = 2,
+        batch_size: int = 64,
+    ) -> None:
+        self.shard = shard
+        self.engine = engine
+        self.cluster = cluster
+        self._log = get_logger(
+            "cadence_tpu.queue.timer-standby",
+            shard=shard.shard_id, cluster=cluster,
+        )
+        self.ack = QueueAckManager(
+            (shard.get_cluster_timer_ack_level(cluster), 0),
+            update_shard_ack=lambda lvl: shard.update_cluster_timer_ack_level(
+                cluster, lvl[0]
+            ),
+        )
+        self.gate = RemoteTimerGate()
+        self.gate.set_current_time(
+            shard.get_remote_cluster_current_time(cluster)
+        )
+        shard.add_remote_time_listener(self._on_remote_time)
+        self._allocator = _StandbyAllocator(engine.domains, cluster)
+        self._stopped = threading.Event()
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=worker_count,
+            thread_name_prefix=f"timer-standby-{cluster}-{shard.shard_id}",
+        )
+        self._batch_size = batch_size
+        self._pump_thread = threading.Thread(
+            target=self._pump,
+            name=f"timer-standby-{cluster}-{shard.shard_id}-pump",
+            daemon=True,
+        )
+
+    def _on_remote_time(self, cluster: str, now_ns: int) -> None:
+        if cluster == self.cluster:
+            self.gate.set_current_time(now_ns)
+
+    def start(self) -> None:
+        self._pump_thread.start()
+
+    def notify(self) -> None:
+        self.gate.update(0)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.gate.update(0)
+        self._pool.shutdown(wait=False)
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.ack.outstanding() == 0:
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- pump (remote-clock-gated) ------------------------------------
+
+    def _pump(self) -> None:
+        while not self._stopped.is_set():
+            self.gate.wait(max_wait_s=0.05)
+            if self._stopped.is_set():
+                return
+            try:
+                self._process_due()
+            except Exception:
+                self._log.exception("standby timer pump failed")
+            self.ack.update_ack_level()
+
+    def _process_due(self) -> None:
+        remote_now = self.gate.current_time()
+        if remote_now <= 0:
+            return  # no view of the remote clock yet: nothing is "due"
+        min_ts = self.ack.ack_level[0]
+        batch = self.shard.persistence.execution.get_timer_tasks(
+            self.shard.shard_id, min_ts, remote_now + 1, self._batch_size
+        )
+        for task in batch:
+            key = (task.visibility_timestamp, task.task_id)
+            if not self.ack.add(key):
+                continue
+            self._pool.submit(self._run_task, task, key)
+        future = self.shard.persistence.execution.get_timer_tasks(
+            self.shard.shard_id, remote_now + 1, 2**62, 1
+        )
+        if future:
+            self.gate.update(future[0].visibility_timestamp)
+
+    def _run_task(self, task: TimerTask, key) -> None:
+        for attempt in range(self._TASK_RETRY_COUNT):
+            if self._stopped.is_set():
+                return
+            try:
+                self._process(task)
+                break
+            except DeferTask:
+                defer_task(self.ack, key)
+                return
+            except EntityNotExistsServiceError:
+                break
+            except Exception:
+                if attempt == self._TASK_RETRY_COUNT - 1:
+                    self._log.exception(
+                        f"standby timer task {key} dropped after "
+                        f"{self._TASK_RETRY_COUNT} attempts"
+                    )
+        # no task-row deletion on standby; cursor-only
+        self.ack.complete(key)
+
+    # -- verification handlers ----------------------------------------
+
+    def _process(self, task: TimerTask) -> None:
+        if task.task_type == TimerTaskType.DeleteHistoryEvent:
+            # retention runs on every cluster (ref timerQueueStandby
+            # taskExecutor executeDeleteHistoryEventTask)
+            self._delete_history(task)
+            return
+        if not self._allocator.owns(task.domain_id):
+            return
+        handler = {
+            TimerTaskType.UserTimer: self._verify_user_timer,
+            TimerTaskType.ActivityTimeout: self._verify_activity_timeout,
+            TimerTaskType.DecisionTimeout: self._verify_decision_timeout,
+            TimerTaskType.WorkflowTimeout: self._verify_workflow_timeout,
+            TimerTaskType.ActivityRetryTimer: lambda t: None,  # active-only
+            TimerTaskType.WorkflowBackoffTimer: self._verify_backoff,
+        }.get(task.task_type)
+        if handler is None:
+            return
+        handler(task)
+
+    def _read(self, task, reader):
+        try:
+            return self.engine.with_workflow(
+                task.domain_id, task.workflow_id, task.run_id,
+                lambda ctx, ms: reader(ms),
+            )
+        except EntityNotExistsServiceError:
+            return None
+
+    def _remote_now(self) -> int:
+        return self.gate.current_time()
+
+    def _verify_user_timer(self, task: TimerTask) -> None:
+        remote_now = self._remote_now()
+
+        def read(ms):
+            if not ms.is_workflow_execution_running():
+                return False
+            for ti in ms.pending_timers.values():
+                if ti.expiry_time <= remote_now:
+                    return True  # fired remotely but not yet replicated
+            return False
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_activity_timeout(self, task: TimerTask) -> None:
+        remote_now = self._remote_now()
+
+        def read(ms):
+            if not ms.is_workflow_execution_running():
+                return False
+            seq = TimerSequence(ms)
+            for expiry, _sid, _tt, _ai in seq._activity_timeout_candidates():
+                if expiry <= remote_now:
+                    return True
+            return False
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_decision_timeout(self, task: TimerTask) -> None:
+        def read(ms):
+            ei = ms.execution_info
+            return (
+                ms.is_workflow_execution_running()
+                and ms.has_pending_decision()
+                and ei.decision_schedule_id == task.event_id
+            )
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_workflow_timeout(self, task: TimerTask) -> None:
+        remote_now = self._remote_now()
+
+        def read(ms):
+            if not ms.is_workflow_execution_running():
+                return False
+            ei = ms.execution_info
+            if ei.workflow_timeout <= 0:
+                return False
+            expiry = ei.start_timestamp + ei.workflow_timeout * 1_000_000_000
+            return expiry <= remote_now
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _verify_backoff(self, task: TimerTask) -> None:
+        def read(ms):
+            return (
+                ms.is_workflow_execution_running()
+                and not ms.has_pending_decision()
+                and ms.execution_info.last_processed_event == EMPTY_EVENT_ID
+            )
+
+        if self._read(task, read):
+            raise DeferTask(task.domain_id)
+
+    def _delete_history(self, task: TimerTask) -> None:
+        from .retention import delete_workflow_retention
+
+        delete_workflow_retention(self.shard, self.engine, task)

@@ -39,10 +39,13 @@ class TimerQueueProcessor:
         matching=None,
         worker_count: int = 4,
         batch_size: int = 64,
+        standby_clusters=(),
     ) -> None:
         self.shard = shard
         self.engine = engine
         self.matching = matching
+        self.standby_clusters = frozenset(standby_clusters)
+        self.has_standby = bool(self.standby_clusters)
         self._log = get_logger("cadence_tpu.queue.timer", shard=shard.shard_id)
         self.ack = QueueAckManager(
             (shard.get_timer_ack_level(), 0),
@@ -138,20 +141,28 @@ class TimerQueueProcessor:
                         f"timer task {key} ({task.task_type}) dropped after "
                         f"{self._TASK_RETRY_COUNT} attempts"
                     )
-        try:
-            self.shard.persistence.execution.complete_timer_task(
-                self.shard.shard_id, task.visibility_timestamp, task.task_id
-            )
-        except Exception:
-            self._log.exception(f"complete_timer_task failed for {key}")
+        if not self.has_standby:   # with standby planes, QueueGC deletes
+            try:
+                self.shard.persistence.execution.complete_timer_task(
+                    self.shard.shard_id, task.visibility_timestamp,
+                    task.task_id,
+                )
+            except Exception:
+                self._log.exception(f"complete_timer_task failed for {key}")
         self.ack.complete(key)
 
     # -- handlers ------------------------------------------------------
 
     def _process(self, task: TimerTask) -> None:
-        if not self._allocator.should_process(task.domain_id):
-            # passive domain: hold the task; it fires here only after a
-            # failover makes this cluster active
+        owner = self._allocator.owning_cluster(task.domain_id)
+        if owner is not None:
+            if owner in self.standby_clusters:
+                # that cluster's standby variant owns it (incl.
+                # retention deletes); failover rewinds this cursor to
+                # the standby cursor
+                return
+            # no standby plane covers the owning cluster: hold the
+            # task; it fires here only after a failover makes us active
             raise DeferTask(task.domain_id)
         handler = {
             TimerTaskType.UserTimer: self._process_user_timer,
@@ -347,37 +358,7 @@ class TimerQueueProcessor:
         self._mutate(task, action)
 
     def _process_delete_history(self, task: TimerTask) -> None:
-        # retention GC (timerQueueProcessorBase deleteHistoryEvent):
-        # remove visibility, mutable state, and the history branch
-        ex = self.shard.persistence.execution
-        vis = self.shard.persistence.visibility
-        hist = self.shard.persistence.history
-        try:
-            record = ex.get_workflow_execution(
-                self.shard.shard_id, task.domain_id, task.workflow_id,
-                task.run_id,
-            )
-        except Exception:
-            return  # already gone
-        if vis is not None:
-            try:
-                vis.delete_workflow_execution(
-                    task.domain_id, task.workflow_id, task.run_id
-                )
-            except Exception:
-                pass
-        branch = record.snapshot.get("execution_info", {}).get("branch_token", b"")
-        ex.delete_current_workflow_execution(
-            self.shard.shard_id, task.domain_id, task.workflow_id, task.run_id
-        )
-        ex.delete_workflow_execution(
-            self.shard.shard_id, task.domain_id, task.workflow_id, task.run_id
-        )
-        if branch and hist is not None:
-            try:
-                hist.delete_history_branch(branch)
-            except Exception:
-                pass
-        self.engine.cache.evict(
-            task.domain_id, task.workflow_id, task.run_id
-        )
+        # retention GC (timerQueueProcessorBase deleteHistoryEvent)
+        from .retention import delete_workflow_retention
+
+        delete_workflow_retention(self.shard, self.engine, task)

@@ -55,11 +55,20 @@ class TransferQueueProcessor(QueueProcessorBase):
         visibility=None,  # VisibilityManager
         worker_count: int = 4,
         batch_size: int = 64,
+        standby_clusters=(),
     ) -> None:
         self.shard = shard
         self.engine = engine
         self.matching = matching
         self.history_client = history_client
+        # when standby variants share this shard's task stream, they own
+        # the passive-domain tasks of THEIR clusters (this processor
+        # skips past those) and the min-ack QueueGC owns row deletion
+        # (per-task delete would starve the standby cursors). A passive
+        # task no standby plane covers is still held via DeferTask.
+        self.standby_clusters = frozenset(standby_clusters)
+        has_standby = bool(self.standby_clusters)
+        self.has_standby = has_standby
         self.visibility = (
             visibility
             if visibility is not None
@@ -82,8 +91,11 @@ class TransferQueueProcessor(QueueProcessorBase):
                 shard.shard_id, level, 2**62, n
             ),
             process_task=self._process,
-            complete_task=lambda t: shard.persistence.execution.complete_transfer_task(
-                shard.shard_id, t.task_id
+            complete_task=(
+                (lambda t: None) if has_standby
+                else lambda t: shard.persistence.execution.complete_transfer_task(
+                    shard.shard_id, t.task_id
+                )
             ),
             task_key=lambda t: t.task_id,
             worker_count=worker_count,
@@ -93,8 +105,16 @@ class TransferQueueProcessor(QueueProcessorBase):
     # -- dispatch ------------------------------------------------------
 
     def _process(self, task: TransferTask) -> None:
-        if not self._allocator.should_process(task.domain_id):
-            # passive domain: hold until failover makes this cluster active
+        owner = self._allocator.owning_cluster(task.domain_id)
+        if owner is not None:
+            if owner in self.standby_clusters:
+                # that cluster's standby variant owns this task; skip
+                # past it. On failover the service rewinds this cursor
+                # to the standby cursor and the verification-based
+                # handlers re-run the span idempotently.
+                return
+            # no standby plane covers the owning cluster: hold until
+            # failover makes us active
             raise DeferTask(task.domain_id)
         handler = {
             TransferTaskType.DecisionTask: self._process_decision,

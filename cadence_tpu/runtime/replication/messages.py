@@ -49,6 +49,10 @@ class ReplicationMessages:
     tasks: List[HistoryTaskV2]
     last_retrieved_id: int
     has_more: bool = False
+    # emitter's clock at serve time — advances the consumer's view of the
+    # remote cluster (ref syncShardStatus / shardContext.SetCurrentTime),
+    # which gates standby timer processing
+    source_time_ns: int = 0
 
 
 class RetryTaskV2Error(Exception):

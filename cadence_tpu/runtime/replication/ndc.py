@@ -186,6 +186,10 @@ class NDCHistoryReplicator:
                 > local.get_current_version_history().last_item().version
             )
             if not plan_holds:
+                # the fallback may re-fork at the same LCA, leaving the
+                # plan-time fork as an orphan branch (the same window
+                # exists on any inline retry after _fork_branch); the
+                # history scavenger owns orphan-branch cleanup
                 self._apply_for_existing(ctx, ms, task)
                 return
             target_vh = local.get_version_history(bi)

@@ -110,6 +110,12 @@ class ReplicationTaskProcessor:
         (at-least-once, matching the reference's lastProcessedMessageId
         ack)."""
         msgs = self.fetcher.fetch(self.shard.shard_id)
+        if msgs.source_time_ns:
+            # the stream carries the source cluster's clock; standby
+            # timer processing fires against it (ref syncShardStatus)
+            self.shard.set_remote_cluster_current_time(
+                self.fetcher.cluster, msgs.source_time_ns
+            )
         if not msgs.tasks:
             # nothing to apply in the range: safe to move past it
             self.fetcher.commit(self.shard.shard_id, msgs.last_retrieved_id)

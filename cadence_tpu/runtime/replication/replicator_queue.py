@@ -147,7 +147,8 @@ class ReplicatorQueueProcessor:
                 out.append(msg)
             last_id = max(last_id, t.task_id)
         return ReplicationMessages(
-            tasks=out, last_retrieved_id=last_id, has_more=has_more
+            tasks=out, last_retrieved_id=last_id, has_more=has_more,
+            source_time_ns=self.shard.now(),
         )
 
     def ack(self, cluster: str, level: int) -> None:

@@ -18,7 +18,13 @@ from .domains import DomainCache
 from .engine.engine import HistoryEngine
 from .membership import Monitor
 from .persistence.interfaces import PersistenceBundle
-from .queues import TimerQueueProcessor, TransferQueueProcessor
+from .queues import (
+    QueueGC,
+    TimerQueueProcessor,
+    TimerQueueStandbyProcessor,
+    TransferQueueProcessor,
+    TransferQueueStandbyProcessor,
+)
 from .shard import ShardContext
 
 
@@ -48,10 +54,20 @@ class HistoryService:
         # processors need clients; clients need the controller)
         self.matching_client = None
         self.history_client = None
+        # remote clusters this host stands by for (standby queue planes)
+        self.standby_clusters: List[str] = []
+        if cluster_metadata is not None:
+            self.standby_clusters = list(
+                cluster_metadata.enabled_remote_clusters()
+            )
         self.controller = ShardController(
             num_shards, persistence, domain_cache, monitor,
             engine_factory=self._build_shard, time_source=time_source,
         )
+        # failover: when a domain becomes active here, rewind the active
+        # cursors to the standby cursor of the cluster it came from so
+        # the skipped passive span is re-verified (idempotent handlers)
+        domain_cache.add_failover_listener(self._on_domain_failover)
 
     def wire(self, matching_client, history_client) -> "HistoryService":
         self.matching_client = matching_client
@@ -72,19 +88,63 @@ class HistoryService:
         engine = HistoryEngine(shard, self.domains)
         engine.cluster_metadata = self.cluster_metadata
         engine.matching_client = self.matching_client
+        has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(
             shard, engine, self.matching_client, self.history_client,
             worker_count=self._queue_workers,
+            standby_clusters=self.standby_clusters,
         )
         timer = TimerQueueProcessor(
             shard, engine, matching=self.matching_client,
             worker_count=self._queue_workers,
+            standby_clusters=self.standby_clusters,
         )
-        engine._task_notifier = transfer.notify
-        engine._timer_notifier = timer.notify
-        transfer.start()
-        timer.start()
-        return _ShardHandle(shard, engine, [transfer, timer])
+        processors = [transfer, timer]
+        notifiers = [transfer.notify]
+        timer_notifiers = [timer.notify]
+        for cluster in self.standby_clusters:
+            ts = TransferQueueStandbyProcessor(shard, engine, cluster)
+            tm = TimerQueueStandbyProcessor(shard, engine, cluster)
+            processors += [ts, tm]
+            notifiers.append(ts.notify)
+            timer_notifiers.append(tm.notify)
+        if has_standby:
+            processors.append(QueueGC(
+                shard, transfer, timer, self.standby_clusters
+            ))
+        engine._task_notifier = lambda: [n() for n in notifiers]
+        engine._timer_notifier = lambda: [n() for n in timer_notifiers]
+        for p in processors:
+            p.start()
+        return _ShardHandle(shard, engine, processors)
+
+    def _on_domain_failover(
+        self, domain_id: str, old_cluster: str, new_cluster: str
+    ) -> None:
+        meta = self.cluster_metadata
+        if meta is None or new_cluster != meta.current_cluster_name:
+            return
+        if old_cluster not in self.standby_clusters:
+            return
+        with self.controller._lock:
+            handles = list(self.controller._handles.values())
+        for handle in handles:
+            shard = handle.shard
+            for p in handle.processors:
+                if isinstance(p, TransferQueueProcessor):
+                    p.ack.rewind(
+                        shard.get_cluster_transfer_ack_level(old_cluster)
+                    )
+                    p.notify()
+                elif isinstance(p, TimerQueueProcessor):
+                    p.ack.rewind(
+                        (shard.get_cluster_timer_ack_level(old_cluster), 0)
+                    )
+                    p.notify()
+        self._log.info(
+            f"domain {domain_id} failed over {old_cluster}->{new_cluster}; "
+            "rewound active queue cursors to standby levels"
+        )
 
     # -- introspection -------------------------------------------------
 

@@ -35,6 +35,8 @@ class ShardContext:
         self.owner = owner
         self.time_source = time_source or RealTimeSource()
         self._lock = threading.RLock()
+        self._remote_cluster_time: dict = {}
+        self._remote_time_listeners: list = []
         self._info = self._acquire()
         self._next_task_seq = 0
 
@@ -107,6 +109,50 @@ class ShardContext:
         with self._lock:
             self._info.timer_ack_level = level
             self._update()
+
+    def get_cluster_transfer_ack_level(self, cluster: str) -> int:
+        """Per-remote-cluster standby cursor; falls back to the shard's
+        own transfer ack level (ref shardContext.go clusterTransferAckLevel)."""
+        with self._lock:
+            return self._info.cluster_transfer_ack_level.get(
+                cluster, self._info.transfer_ack_level
+            )
+
+    def update_cluster_transfer_ack_level(self, cluster: str, level: int) -> None:
+        with self._lock:
+            self._info.cluster_transfer_ack_level[cluster] = level
+            self._update()
+
+    def get_cluster_timer_ack_level(self, cluster: str) -> int:
+        with self._lock:
+            return self._info.cluster_timer_ack_level.get(
+                cluster, self._info.timer_ack_level
+            )
+
+    def update_cluster_timer_ack_level(self, cluster: str, level: int) -> None:
+        with self._lock:
+            self._info.cluster_timer_ack_level[cluster] = level
+            self._update()
+
+    # -- remote cluster clocks (ref shardContext.go SetCurrentTime) ----
+
+    def set_remote_cluster_current_time(self, cluster: str, now_ns: int) -> None:
+        """Advance the view of a remote cluster's clock (fed by its
+        replication stream); standby timer processing fires against this
+        clock, never the local one."""
+        with self._lock:
+            cur = self._remote_cluster_time.get(cluster, 0)
+            if now_ns > cur:
+                self._remote_cluster_time[cluster] = now_ns
+        for listener in list(self._remote_time_listeners):
+            listener(cluster, now_ns)
+
+    def get_remote_cluster_current_time(self, cluster: str) -> int:
+        with self._lock:
+            return self._remote_cluster_time.get(cluster, 0)
+
+    def add_remote_time_listener(self, fn) -> None:
+        self._remote_time_listeners.append(fn)
 
     def get_replication_ack_level(self) -> int:
         with self._lock:

@@ -1,0 +1,239 @@
+"""Standby queue processors: verify-and-discharge for passive domains,
+remote-clock-gated timers, and lossless failover takeover.
+
+Reference: service/history/transferQueueStandbyProcessor.go,
+timerQueueStandbyProcessor.go, timerGate.go:164 (RemoteTimerGate), and
+the failover takeover in transferQueueProcessor.go — the new active
+side re-reads the span its active cursor skipped while passive.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+from cadence_tpu.core import history_factory as F
+from cadence_tpu.matching import MatchingEngine
+from cadence_tpu.matching.engine import PollRequest
+from cadence_tpu.runtime.domains import DomainCache, register_domain
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.queues import (
+    TimerQueueStandbyProcessor,
+    TransferQueueStandbyProcessor,
+)
+from cadence_tpu.runtime.replication import HistoryTaskV2
+from cadence_tpu.runtime.service import HistoryService
+
+SECOND = 1_000_000_000
+# "now": after a failover the timer pipeline becomes active for the
+# domain, and a stale start timestamp would legitimately fire the
+# workflow-timeout before the takeover assertions run
+T0 = time.time_ns()
+DOMAIN = "standby-domain"
+ACTIVE_V = 1
+
+
+class Box:
+    """This host runs cluster 'standby'; the domain is active in
+    'active' — so every replicated workflow's tasks are standby work."""
+
+    def __init__(self):
+        self.persistence = create_memory_bundle()
+        self.domain_id = register_domain(
+            self.persistence.metadata, DOMAIN, is_global=True,
+            clusters=["active", "standby"], active_cluster="active",
+            failover_version=ACTIVE_V,
+        )
+        self.domains = DomainCache(self.persistence.metadata)
+        self.history = HistoryService(
+            1, self.persistence, self.domains,
+            single_host_monitor("standby-host"),
+            cluster_metadata=ClusterMetadata(
+                failover_version_increment=10,
+                master_cluster_name="active",
+                current_cluster_name="standby",
+                cluster_info={
+                    "active": ClusterInformation(initial_failover_version=1),
+                    "standby": ClusterInformation(initial_failover_version=2),
+                },
+            ),
+        )
+        self.history_client = HistoryClient(self.history.controller)
+        self.matching = MatchingEngine(
+            self.persistence.task, self.history_client
+        )
+        self.history.wire(MatchingClient(self.matching), self.history_client)
+        self.history.start()
+        self.engine = self.history.controller.get_engine_for_shard(0)
+        self.shard = self.engine.shard
+
+    def stop(self):
+        self.history.stop()
+        self.matching.shutdown()
+
+    def handle(self):
+        with self.history.controller._lock:
+            return list(self.history.controller._handles.values())[0]
+
+    def standby_procs(self):
+        ts = tm = None
+        for p in self.handle().processors:
+            if isinstance(p, TransferQueueStandbyProcessor):
+                ts = p
+            elif isinstance(p, TimerQueueStandbyProcessor):
+                tm = p
+        return ts, tm
+
+
+@pytest.fixture()
+def box():
+    b = Box()
+    yield b
+    b.stop()
+
+
+def _matching_backlog(box) -> int:
+    d = box.matching.describe_task_list(box.domain_id, "tl", 0)
+    return int(d.get("backlog_hint", 0))
+
+
+def _task(box, wf, run, items, events, task_id):
+    return HistoryTaskV2(
+        task_id=task_id, domain_id=box.domain_id, workflow_id=wf,
+        run_id=run, version_history_items=items, events=events,
+    )
+
+
+def _replicate_started_with_decision(box, wf, run):
+    b1 = [
+        F.workflow_execution_started(
+            1, ACTIVE_V, T0, task_list="tl", workflow_type="wt",
+            execution_start_to_close_timeout_seconds=300,
+            task_start_to_close_timeout_seconds=10,
+        ),
+        F.decision_task_scheduled(2, ACTIVE_V, T0),
+    ]
+    box.engine.replicate_events_v2(
+        _task(box, wf, run, [{"event_id": 2, "version": ACTIVE_V}], b1, 1)
+    )
+
+
+def _wait(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_standby_processors_wired(box):
+    ts, tm = box.standby_procs()
+    assert ts is not None and tm is not None
+    assert ts.cluster == "active" and tm.cluster == "active"
+
+
+def test_standby_holds_unreplicated_decision_and_discharges_after(box):
+    """The decision transfer task is held while the decision is pending
+    un-started (the outcome hasn't replicated), then discharged once the
+    started event arrives — WITHOUT ever pushing to matching."""
+    wf, run = "wf-sb", "run-sb"
+    _replicate_started_with_decision(box, wf, run)
+    ts, _ = box.standby_procs()
+
+    # the task stays in the queue (held) and matching never sees it
+    time.sleep(0.3)
+    assert _matching_backlog(box) == 0
+    tasks = box.persistence.execution.get_transfer_tasks(0, 0, 2**62, 10)
+    assert any(t.workflow_id == wf for t in tasks), "task must be held"
+
+    # replicate the started event → verification passes → discharge
+    b2 = [F.decision_task_started(3, ACTIVE_V, T0 + SECOND,
+                                  scheduled_event_id=2)]
+    box.engine.replicate_events_v2(
+        _task(box, wf, run, [{"event_id": 3, "version": ACTIVE_V}], b2, 2)
+    )
+    assert _wait(
+        lambda: not any(
+            t.workflow_id == wf
+            for t in box.persistence.execution.get_transfer_tasks(
+                0, 0, 2**62, 10
+            )
+        )
+    ), "discharged standby task should be GC'd past min ack"
+    # and still nothing was dispatched to matching
+    assert _matching_backlog(box) == 0
+
+
+def test_standby_records_visibility(box):
+    wf, run = "wf-vis", "run-vis"
+    _replicate_started_with_decision(box, wf, run)
+    assert _wait(lambda: any(
+        r.workflow_id == wf
+        for r in box.persistence.visibility.list_open_workflow_executions(
+            box.domain_id, 0, 2**62, page_size=10
+        )[0]
+    )), "standby side must record started visibility"
+
+
+def test_timer_standby_gated_on_remote_clock(box):
+    """Timer tasks are judged against the REMOTE cluster's clock: with
+    no remote-clock view nothing is due; advancing the remote clock
+    past a deadline lets verification run (and hold, since the timeout
+    outcome hasn't replicated)."""
+    wf, run = "wf-timer", "run-timer"
+    _replicate_started_with_decision(box, wf, run)
+    _, tm = box.standby_procs()
+    assert tm.gate.current_time() == 0
+    timer_tasks = box.persistence.execution.get_timer_tasks(0, 0, 2**62, 10)
+    assert timer_tasks, "replicated decision should have a timeout task"
+
+    # no remote clock yet → the standby pump considers nothing due
+    time.sleep(0.2)
+    assert tm.ack.ack_level[0] == 0
+
+    # advance the remote cluster's clock past every deadline
+    box.shard.set_remote_cluster_current_time("active", T0 + 3600 * SECOND)
+    # the decision is still pending → the timeout task is HELD (the
+    # active side would fire it; standby waits for replication)
+    time.sleep(0.3)
+    still = box.persistence.execution.get_timer_tasks(0, 0, 2**62, 10)
+    assert any(t.workflow_id == wf for t in still)
+
+
+def test_failover_takeover_without_loss(box):
+    """Promote the domain to this cluster: the active processors rewind
+    to the standby cursor and dispatch the held decision to matching."""
+    wf, run = "wf-fo", "run-fo"
+    _replicate_started_with_decision(box, wf, run)
+    time.sleep(0.3)   # standby plane holds the task; active skips it
+    assert _matching_backlog(box) == 0
+
+    # failover: domain becomes active HERE (bump failover version the
+    # way the reference's failover API does)
+    rec = box.persistence.metadata.get_domain(id=box.domain_id)
+    rec.replication_config.active_cluster_name = "standby"
+    rec.failover_version = 12
+    box.persistence.metadata.update_domain(rec)
+
+    # takeover: the held decision task must reach matching. No poller is
+    # waiting, so dispatch lands in the backlog (a short-timeout probe
+    # poll here could consume the task just past its own deadline and
+    # the response would be discarded — don't poll until it's there).
+    def backlogged():
+        box.domains.get_by_id(box.domain_id)   # poke cache refresh
+        return _matching_backlog(box) > 0
+
+    assert _wait(backlogged, timeout_s=8.0), (
+        "after failover the active queue must dispatch the decision "
+        "task that was held on standby"
+    )
+    task = box.matching.poll_for_decision_task(
+        PollRequest(domain_id=box.domain_id, task_list="tl",
+                    identity="probe", timeout_s=2.0)
+    )
+    assert task is not None
