@@ -3,13 +3,19 @@
 Reference: /root/reference/client/ — per-service clients that resolve
 the owning host through the membership ring and dispatch RPCs
 (history routes by workflowID → shard → host,
-client/history/client.go:844-846; matching routes by task list). In
-this build dispatch is an in-process call into the target host's
-engine registry; a gRPC transport can replace `_dispatch` without
-touching callers.
+client/history/client.go:844-846; matching routes by task list).
+HistoryClient/MatchingClient dispatch in-process into the target host's
+engine registry; the Routed* variants add the process boundary — ring
+lookup → host address → gRPC stub (rpc/server.py endpoints).
 """
 
 from .history import HistoryClient
 from .matching import MatchingClient
+from .routed import RoutedHistoryClient, RoutedMatchingClient
 
-__all__ = ["HistoryClient", "MatchingClient"]
+__all__ = [
+    "HistoryClient",
+    "MatchingClient",
+    "RoutedHistoryClient",
+    "RoutedMatchingClient",
+]

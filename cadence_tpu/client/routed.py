@@ -1,0 +1,111 @@
+"""Cross-process routed clients: ring lookup → host address → gRPC stub.
+
+Reference: client/history/client.go:844-846 (GetClientForKey: shard key
+→ membership ring → host → RPC client) and client/matching/client.go
+(task-list-name routing). The in-process clients (client/history.py,
+client/matching.py) short-circuit to local engines; these variants add
+the process boundary: a shard (or task list) owned by another host is
+reached through that host's History/Matching gRPC endpoint
+(rpc/server.py), with stubs cached per address.
+
+Host identities in the membership ring ARE dial addresses (the
+reference's ringpop identities are host:port the same way), so routing
+needs no separate address registry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from cadence_tpu.runtime.membership import Monitor
+from cadence_tpu.utils.hashing import shard_for_workflow
+
+from .history import HistoryClient
+from .matching import MatchingClient
+
+
+class _StubCache:
+    def __init__(self, factory) -> None:
+        self._factory = factory
+        self._stubs: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str):
+        with self._lock:
+            stub = self._stubs.get(address)
+            if stub is None:
+                stub = self._stubs[address] = self._factory(address)
+            return stub
+
+    def close(self) -> None:
+        with self._lock:
+            for stub in self._stubs.values():
+                stub.close()
+            self._stubs.clear()
+
+
+class RoutedHistoryClient(HistoryClient):
+    """HistoryClient surface; shard → ring("history") → local engine or
+    remote History endpoint."""
+
+    def __init__(
+        self,
+        monitor: Monitor,
+        local_controller=None,
+        num_shards: Optional[int] = None,
+    ) -> None:
+        from cadence_tpu.rpc.client import RemoteHistory
+
+        super().__init__(
+            {} if local_controller is None
+            else {local_controller.identity: local_controller}
+        )
+        self.monitor = monitor
+        self.local = local_controller
+        self.num_shards = (
+            num_shards if num_shards is not None
+            else (local_controller.num_shards if local_controller else 1)
+        )
+        self._stubs = _StubCache(RemoteHistory)
+
+    def _call(self, workflow_id: str, method: str, *args, **kwargs):
+        shard_id = shard_for_workflow(workflow_id, self.num_shards)
+        owner = self.monitor.resolver("history").lookup(
+            str(shard_id)
+        ).identity
+        if self.local is not None and owner == self.local.identity:
+            return getattr(
+                self.local.get_engine_for_shard(shard_id), method
+            )(*args, **kwargs)
+        return getattr(self._stubs.get(owner), method)(*args, **kwargs)
+
+    def close(self) -> None:
+        self._stubs.close()
+
+
+class RoutedMatchingClient(MatchingClient):
+    """MatchingClient surface; task list → ring("matching") → local
+    engine or remote Matching endpoint."""
+
+    def __init__(self, monitor: Monitor, local_engine=None,
+                 local_identity: str = "") -> None:
+        from cadence_tpu.rpc.client import RemoteMatching
+
+        super().__init__(
+            {local_identity or "local": local_engine}
+            if local_engine is not None else {}
+        )
+        self.monitor = monitor
+        self.local_engine = local_engine
+        self.local_identity = local_identity or monitor.self_identity
+        self._stubs = _StubCache(RemoteMatching)
+
+    def _engine_for(self, task_list: str):
+        owner = self.monitor.resolver("matching").lookup(task_list).identity
+        if self.local_engine is not None and owner == self.local_identity:
+            return self.local_engine
+        return self._stubs.get(owner)
+
+    def close(self) -> None:
+        self._stubs.close()

@@ -18,7 +18,10 @@ from . import codec
 
 _SERVICE = "cadence_tpu.Frontend"
 
+from cadence_tpu.runtime.controller import ShardOwnershipLostError
+
 ERROR_TYPES = {
+    "ShardOwnershipLostError": ShardOwnershipLostError,
     "BadRequestError": A.BadRequestError,
     "EntityNotExistsServiceError": A.EntityNotExistsServiceError,
     "EntityNotExistsError": A.EntityNotExistsServiceError,
@@ -35,9 +38,10 @@ ERROR_TYPES = {
 
 
 class _Method:
-    def __init__(self, channel: grpc.Channel, name: str) -> None:
+    def __init__(self, channel: grpc.Channel, name: str,
+                 service: str = _SERVICE) -> None:
         self._call = channel.unary_unary(
-            f"/{_SERVICE}/{name}",
+            f"/{service}/{name}",
             request_serializer=codec.dumps,
             response_deserializer=codec.loads_envelope,
         )
@@ -63,8 +67,11 @@ def _build(exc_type, msg):
         return e
 
 
-class RemoteFrontend:
-    """Dial a frontend; use exactly like a local WorkflowHandler."""
+class RemoteService:
+    """Dial a service endpoint; any method is callable by name with the
+    same surface as the server-side handler."""
+
+    _service = _SERVICE
 
     def __init__(self, address: str) -> None:
         self.address = address
@@ -76,8 +83,28 @@ class RemoteFrontend:
             raise AttributeError(name)
         m = self._methods.get(name)
         if m is None:
-            m = self._methods[name] = _Method(self._channel, name)
+            m = self._methods[name] = _Method(
+                self._channel, name, self._service
+            )
         return m
 
     def close(self) -> None:
         self._channel.close()
+
+
+class RemoteFrontend(RemoteService):
+    """Dial a frontend; use exactly like a local WorkflowHandler."""
+
+    _service = "cadence_tpu.Frontend"
+
+
+class RemoteHistory(RemoteService):
+    """Dial a history host; same surface as an in-proc HistoryClient."""
+
+    _service = "cadence_tpu.History"
+
+
+class RemoteMatching(RemoteService):
+    """Dial a matching host; same surface as a MatchingEngine."""
+
+    _service = "cadence_tpu.Matching"

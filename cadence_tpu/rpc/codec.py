@@ -25,10 +25,12 @@ def register(cls: type) -> type:
 
 
 def _register_defaults() -> None:
+    from cadence_tpu.matching.engine import PollRequest
     from cadence_tpu.runtime import api as A
     from cadence_tpu.runtime.persistence import records as R
 
     for cls in (
+        PollRequest,
         A.StartWorkflowRequest,
         A.SignalRequest,
         A.SignalWithStartRequest,
@@ -70,7 +72,11 @@ def encode(obj: Any) -> Any:
     if isinstance(obj, list):
         return [encode(v) for v in obj]
     if isinstance(obj, dict):
-        return {str(k): encode(v) for k, v in obj.items()}
+        enc = {str(k): encode(v) for k, v in obj.items()}
+        if any(k in enc for k in ("__b", "__ev", "__t", "__dc", "__esc")):
+            # user payloads may legitimately carry marker-shaped keys
+            return {"__esc": enc}
+        return enc
     if isinstance(obj, (set, frozenset)):
         return {"__t": [encode(v) for v in sorted(obj)]}
     raise TypeError(f"cannot encode {type(obj).__name__}")
@@ -86,6 +92,8 @@ def decode(obj: Any) -> Any:
             return HistoryEvent.from_dict(obj["__ev"])
         if "__t" in obj and len(obj) == 1:
             return tuple(decode(v) for v in obj["__t"])
+        if "__esc" in obj and len(obj) == 1:
+            return {k: decode(v) for k, v in obj["__esc"].items()}
         if "__dc" in obj:
             if not _REGISTRY:
                 _register_defaults()

@@ -1,10 +1,17 @@
-"""gRPC server over generic handlers: the frontend's network endpoint.
+"""gRPC servers over generic handlers: every service's network endpoint.
 
-Reference: common/rpc.go dispatcher + service/frontend Thrift server.
-Methods are dispatched by name to the WorkflowHandler/AdminHandler;
-requests/responses ride the JSON codec; service errors map to gRPC
-status codes with the error class in the details for client-side
-re-raise.
+Reference: common/rpc.go dispatcher + the per-service Thrift servers
+(service/frontend, service/history/handler.go:227,
+service/matching/handler.go). Methods are dispatched by name to the
+target handler objects; requests/responses ride the JSON codec; service
+errors map to gRPC status codes with the error class in the details for
+client-side re-raise.
+
+The history endpoint's targets are an in-process HistoryClient bound to
+the LOCAL shard controller plus the HistoryService — exactly the
+reference shape where the receiving host's handler re-resolves the
+shard's engine and surfaces ShardOwnershipLostError to the caller for
+retry after the ring settles (handler.go:262).
 """
 
 from __future__ import annotations
@@ -18,7 +25,10 @@ from cadence_tpu.runtime import api as A
 
 from . import codec
 
-_SERVICE = "cadence_tpu.Frontend"
+FRONTEND_SERVICE = "cadence_tpu.Frontend"
+HISTORY_SERVICE = "cadence_tpu.History"
+MATCHING_SERVICE = "cadence_tpu.Matching"
+_SERVICE = FRONTEND_SERVICE  # compat
 
 # error class name → grpc status (client reverses via ERROR_TYPES)
 ERROR_CODES = {
@@ -35,12 +45,15 @@ ERROR_CODES = {
     "ServiceBusyError": grpc.StatusCode.RESOURCE_EXHAUSTED,
     "ClientVersionNotSupportedError": grpc.StatusCode.FAILED_PRECONDITION,
     "InternalServiceError": grpc.StatusCode.INTERNAL,
+    # shard moved: retryable routing error (retryableClient.go)
+    "ShardOwnershipLostError": grpc.StatusCode.UNAVAILABLE,
 }
 
 
 class _Generic(grpc.GenericRpcHandler):
-    def __init__(self, targets) -> None:
+    def __init__(self, targets, service: str = FRONTEND_SERVICE) -> None:
         self._targets = targets  # list of handler objects, first match
+        self._service = service
 
     def _resolve(self, name: str):
         for target in self._targets:
@@ -50,7 +63,7 @@ class _Generic(grpc.GenericRpcHandler):
         return None
 
     def service(self, call_details):
-        prefix = f"/{_SERVICE}/"
+        prefix = f"/{self._service}/"
         if not call_details.method.startswith(prefix):
             return None
         name = call_details.method[len(prefix):]
@@ -74,22 +87,68 @@ class _Generic(grpc.GenericRpcHandler):
         )
 
 
-class FrontendRPCServer:
+class ServiceRPCServer:
+    """A gRPC endpoint dispatching one service's methods by name."""
+
+    def __init__(
+        self, service: str, targets, address: str = "127.0.0.1:0",
+        max_workers: int = 16, server: Optional[grpc.Server] = None,
+    ) -> None:
+        self.service = service
+        self._owns_server = server is None
+        self._server = server or grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers(
+            (_Generic(list(targets), service),)
+        )
+        if self._owns_server:
+            self.port = self._server.add_insecure_port(address)
+            self.address = f"127.0.0.1:{self.port}"
+
+    def start(self) -> "ServiceRPCServer":
+        if self._owns_server:
+            self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        if self._owns_server:
+            self._server.stop(grace)
+
+
+class FrontendRPCServer(ServiceRPCServer):
     def __init__(
         self, frontend, admin=None, address: str = "127.0.0.1:0",
         max_workers: int = 16,
     ) -> None:
-        self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers)
-        )
         targets = [frontend] + ([admin] if admin is not None else [])
-        self._server.add_generic_rpc_handlers((_Generic(targets),))
-        self.port = self._server.add_insecure_port(address)
-        self.address = f"127.0.0.1:{self.port}"
+        super().__init__(FRONTEND_SERVICE, targets, address, max_workers)
 
-    def start(self) -> "FrontendRPCServer":
-        self._server.start()
-        return self
 
-    def stop(self, grace: Optional[float] = 0.5) -> None:
-        self._server.stop(grace)
+class HistoryRPCServer(ServiceRPCServer):
+    """This host's history endpoint: an in-proc HistoryClient over the
+    LOCAL controller resolves each call's shard engine (not-owned shards
+    raise ShardOwnershipLostError back to the remote caller)."""
+
+    def __init__(
+        self, history_service, address: str = "127.0.0.1:0",
+        max_workers: int = 16, server: Optional[grpc.Server] = None,
+    ) -> None:
+        from cadence_tpu.client.history import HistoryClient
+
+        local = HistoryClient(history_service.controller)
+        super().__init__(
+            HISTORY_SERVICE, [local, history_service], address,
+            max_workers, server=server,
+        )
+
+
+class MatchingRPCServer(ServiceRPCServer):
+    def __init__(
+        self, matching_engine, address: str = "127.0.0.1:0",
+        max_workers: int = 16, server: Optional[grpc.Server] = None,
+    ) -> None:
+        super().__init__(
+            MATCHING_SERVICE, [matching_engine], address, max_workers,
+            server=server,
+        )

@@ -104,6 +104,9 @@ class _Db:
     def __init__(self, path: str) -> None:
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
+        # writers from other PROCESSES (two-process service plane) wait
+        # instead of failing immediately with SQLITE_BUSY
+        self.conn.execute("PRAGMA busy_timeout=5000")
         self.conn.executescript(_SCHEMA)
         self.conn.commit()
         self.lock = threading.RLock()
@@ -207,7 +210,7 @@ class SqliteExecutionManager(I.ExecutionManager):
             (
                 shard_id, snap.domain_id, snap.workflow_id, snap.run_id,
                 snap.next_event_id, snap.last_write_version,
-                json.dumps(snap.snapshot),
+                serde.snapshot_to_json(snap.snapshot),
             ),
         )
         self._put_tasks(c, shard_id, snap)
@@ -270,15 +273,15 @@ class SqliteExecutionManager(I.ExecutionManager):
                  cur_row[0]),
             ).fetchone()
             if old:
-                snap = json.loads(old[0])
+                snap = serde.snapshot_from_json(old[0])
                 ex = snap.get("execution_info")
                 if isinstance(ex, dict):
                     ex["state"] = 3
                 c.execute(
                     "UPDATE executions SET snapshot=? WHERE shard_id=? AND "
                     "domain_id=? AND workflow_id=? AND run_id=?",
-                    (json.dumps(snap), shard_id, snapshot.domain_id,
-                     snapshot.workflow_id, cur_row[0]),
+                    (serde.snapshot_to_json(snap), shard_id,
+                     snapshot.domain_id, snapshot.workflow_id, cur_row[0]),
                 )
         else:
             raise ValueError(f"unknown create mode {mode}")
@@ -318,7 +321,7 @@ class SqliteExecutionManager(I.ExecutionManager):
         if not row:
             raise EntityNotExistsError(f"execution {workflow_id}/{run_id}")
         return GetWorkflowResponse(
-            snapshot=json.loads(row[0]), next_event_id=row[1]
+            snapshot=serde.snapshot_from_json(row[0]), next_event_id=row[1]
         )
 
     def update_workflow_execution(

@@ -3,12 +3,24 @@
 Reference: /root/reference/service/history/queueAckMgr.go — tasks are
 read in order, complete in any order; the ack level advances over the
 longest finished prefix and is checkpointed into shardInfo.
+
+Entry states: RUNNING (handed to a worker), DONE (swept by
+update_ack_level), DEFERRED (held: the handler raised DeferTask and the
+task must be re-read later), RETRY (the defer delay elapsed; the next
+pump read may re-take it). A DEFERRED/RETRY entry keeps blocking the
+ack sweep — the cursor must never pass a task that was read but not
+processed, or queue GC would delete it unexecuted.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional
+
+_RUNNING = 0
+_DONE = 1
+_DEFERRED = 2
+_RETRY = 3
 
 
 class QueueAckManager:
@@ -20,45 +32,57 @@ class QueueAckManager:
         self._lock = threading.Lock()
         self.ack_level = ack_level  # int task_id or (ts, task_id) for timers
         self.read_level = ack_level
-        self._outstanding: Dict[object, bool] = {}  # key → done
+        self._outstanding: Dict[object, int] = {}  # key → state
         self._update_shard_ack = update_shard_ack
 
     def add(self, key) -> bool:
         """Register a read task; False if already outstanding (dup read)
         or already acked (a completed frontier row re-read because queue
-        GC deletes exclusively below the ack level)."""
+        GC deletes exclusively below the ack level). A RETRY entry (its
+        defer delay elapsed) is re-taken."""
         with self._lock:
-            if key in self._outstanding or key <= self.ack_level:
+            if key <= self.ack_level:
                 return False
-            self._outstanding[key] = False
-            if key > self.read_level:
-                self.read_level = key
-            return True
+            state = self._outstanding.get(key)
+            if state is None:
+                self._outstanding[key] = _RUNNING
+                if key > self.read_level:
+                    self.read_level = key
+                return True
+            if state == _RETRY:
+                self._outstanding[key] = _RUNNING
+                return True
+            return False
 
     def complete(self, key) -> None:
         with self._lock:
             if key in self._outstanding:
-                self._outstanding[key] = True
+                self._outstanding[key] = _DONE
 
     def update_ack_level(self):
         """Advance over the finished prefix; checkpoint to the shard
-        only when the level actually moved."""
+        only when the level actually moved. The checkpoint happens under
+        the lock so a concurrent rewind() cannot be overwritten by a
+        stale higher level."""
         with self._lock:
             before = self.ack_level
             for key in sorted(self._outstanding):
-                if not self._outstanding[key]:
+                if self._outstanding[key] != _DONE:
                     break
                 del self._outstanding[key]
                 self.ack_level = key
             level = self.ack_level
-        if level != before and self._update_shard_ack is not None:
-            self._update_shard_ack(level)
+            if level != before and self._update_shard_ack is not None:
+                self._update_shard_ack(level)
         return level
 
     def rewind(self, level) -> None:
         """Move the cursor back to ``level`` (failover reprocessing: the
         new active side re-reads from the standby cursor; verification-
-        based handlers make re-execution idempotent)."""
+        based handlers make re-execution idempotent). Persisted
+        immediately (under the lock, so no concurrent checkpoint can
+        overwrite it): a restart re-initializes from the shard cursor
+        and the failover event will not re-fire."""
         with self._lock:
             if level >= self.ack_level:
                 return
@@ -70,10 +94,8 @@ class QueueAckManager:
             # being re-verified
             for key in [k for k in self._outstanding if k > level]:
                 del self._outstanding[key]
-        # persist immediately: a restart re-initializes from the shard
-        # cursor, and the failover event will not re-fire
-        if self._update_shard_ack is not None:
-            self._update_shard_ack(level)
+            if self._update_shard_ack is not None:
+                self._update_shard_ack(level)
 
     def set_read_level(self, level) -> None:
         with self._lock:
@@ -81,14 +103,41 @@ class QueueAckManager:
                 self.read_level = level
 
     def outstanding(self) -> int:
+        """In-flight work items. Parked entries (DEFERRED/RETRY) are not
+        counted — they still block the ack sweep, but drain()/quiesce
+        checks must not wait on tasks that are parked indefinitely."""
         with self._lock:
-            return len(self._outstanding)
+            return sum(
+                1 for s in self._outstanding.values()
+                if s in (_RUNNING, _DONE)
+            )
+
+    def defer(self, key, delay_s: float) -> None:
+        """Hold a read-but-unprocessable task (passive domain / standby
+        verification pending). The entry stays outstanding — blocking
+        the ack sweep, so queue GC cannot delete the row — and becomes
+        re-takeable (RETRY) after ``delay_s``, when the read level also
+        rewinds so the pump re-reads it."""
+        with self._lock:
+            if self._outstanding.get(key) != _RUNNING:
+                return
+            self._outstanding[key] = _DEFERRED
+
+        def ready() -> None:
+            with self._lock:
+                if self._outstanding.get(key) == _DEFERRED:
+                    self._outstanding[key] = _RETRY
+                    self.read_level = self.ack_level
+
+        t = threading.Timer(delay_s, ready)
+        t.daemon = True
+        t.start()
 
     def abandon(self, key) -> None:
-        """Un-register a task WITHOUT completing it: the pump will
-        re-read it later (deferred standby tasks). The read level rewinds
-        to the ack level so nothing between ack and read is skipped;
-        still-outstanding keys dedup via add()."""
+        """Un-register a task WITHOUT completing it. Unlike defer(),
+        the entry is dropped entirely — only safe when the caller KNOWS
+        the task will be re-read before the sweep passes it (legacy
+        callers); prefer defer()."""
         with self._lock:
             self._outstanding.pop(key, None)
             self.read_level = self.ack_level

@@ -50,11 +50,8 @@ STANDBY_RETRY_DELAY_S = 0.5
 
 
 def defer_task(ack, key, delay_s: float = STANDBY_RETRY_DELAY_S) -> None:
-    """Release a deferred (passive-domain) task back to its queue after
-    a standby delay: the ack entry is abandoned on a timer so the pump
-    re-reads it without hot-looping."""
-    import threading
-
-    t = threading.Timer(delay_s, ack.abandon, [key])
-    t.daemon = True
-    t.start()
+    """Hold a deferred (passive-domain / standby-unverified) task: the
+    ack entry stays outstanding — blocking the ack sweep so queue GC
+    cannot delete the row — and becomes re-readable after the standby
+    delay (QueueAckManager.defer)."""
+    ack.defer(key, delay_s)

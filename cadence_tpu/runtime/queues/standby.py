@@ -70,6 +70,9 @@ class QueueGC:
         self.standby_clusters = list(standby_clusters)
         self._interval = interval_s
         self._stopped = threading.Event()
+        self._gclog = get_logger(
+            "cadence_tpu.queue.gc", shard=shard.shard_id
+        )
         self._thread = threading.Thread(
             target=self._loop, name=f"queue-gc-{shard.shard_id}", daemon=True
         )
@@ -116,7 +119,9 @@ class QueueGC:
             try:
                 self.collect()
             except Exception:
-                pass
+                # with standby planes, GC is the ONLY row deletion; a
+                # persistent failure means unbounded task-table growth
+                self._gclog.exception("queue GC collect failed")
 
 
 class _StandbyAllocator:
@@ -161,6 +166,7 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
             shard=shard.shard_id, cluster=cluster,
         )
         self._allocator = _StandbyAllocator(engine.domains, cluster)
+        shard.ensure_cluster_ack_levels(cluster)
         ack = QueueAckManager(
             shard.get_cluster_transfer_ack_level(cluster),
             update_shard_ack=lambda lvl: shard.update_cluster_transfer_ack_level(
@@ -280,34 +286,16 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
             raise DeferTask(task.domain_id)
 
     def _record_started(self, task: TransferTask) -> None:
-        def read(ms):
-            ei = ms.execution_info
-            return VisibilityRecord(
-                domain_id=task.domain_id,
-                workflow_id=task.workflow_id,
-                run_id=task.run_id,
-                workflow_type=ei.workflow_type_name,
-                start_time=ei.start_timestamp,
-                execution_time=ei.start_timestamp,
-                memo=dict(ei.memo),
-                search_attributes=dict(ei.search_attributes),
-            )
+        from .transfer import open_visibility_record
 
-        rec = self._read(task, read)
+        rec = self._read(task, lambda ms: open_visibility_record(task, ms))
         if rec is not None and self.visibility is not None:
             self.visibility.record_workflow_execution_started(rec)
 
     def _upsert(self, task: TransferTask) -> None:
-        rec = self._read(task, lambda ms: VisibilityRecord(
-            domain_id=task.domain_id,
-            workflow_id=task.workflow_id,
-            run_id=task.run_id,
-            workflow_type=ms.execution_info.workflow_type_name,
-            start_time=ms.execution_info.start_timestamp,
-            execution_time=ms.execution_info.start_timestamp,
-            memo=dict(ms.execution_info.memo),
-            search_attributes=dict(ms.execution_info.search_attributes),
-        ))
+        from .transfer import open_visibility_record
+
+        rec = self._read(task, lambda ms: open_visibility_record(task, ms))
         if rec is not None and self.visibility is not None:
             self.visibility.upsert_workflow_execution(rec)
 
@@ -333,6 +321,7 @@ class TimerQueueStandbyProcessor:
             "cadence_tpu.queue.timer-standby",
             shard=shard.shard_id, cluster=cluster,
         )
+        shard.ensure_cluster_ack_levels(cluster)
         self.ack = QueueAckManager(
             (shard.get_cluster_timer_ack_level(cluster), 0),
             update_shard_ack=lambda lvl: shard.update_cluster_timer_ack_level(

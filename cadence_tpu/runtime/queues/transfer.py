@@ -35,6 +35,22 @@ from .ack import QueueAckManager
 from .allocator import DeferTask, TaskAllocator
 from .base import QueueProcessorBase
 
+def open_visibility_record(task, ms) -> VisibilityRecord:
+    """Open-execution visibility record from mutable state (shared by
+    the active and standby transfer pipelines)."""
+    ei = ms.execution_info
+    return VisibilityRecord(
+        domain_id=task.domain_id,
+        workflow_id=task.workflow_id,
+        run_id=task.run_id,
+        workflow_type=ei.workflow_type_name,
+        start_time=ei.start_timestamp,
+        execution_time=ei.start_timestamp,
+        memo=dict(ei.memo),
+        search_attributes=dict(ei.search_attributes),
+    )
+
+
 # close status → the child-close event type recorded in the parent
 _CLOSE_EVENT = {
     int(CloseStatus.Completed): EventType.ChildWorkflowExecutionCompleted,
@@ -465,20 +481,9 @@ class TransferQueueProcessor(QueueProcessorBase):
             return None
 
     def _open_visibility_record(self, task: TransferTask):
-        def read(ms):
-            ei = ms.execution_info
-            return VisibilityRecord(
-                domain_id=task.domain_id,
-                workflow_id=task.workflow_id,
-                run_id=task.run_id,
-                workflow_type=ei.workflow_type_name,
-                start_time=ei.start_timestamp,
-                execution_time=ei.start_timestamp,
-                memo=dict(ei.memo),
-                search_attributes=dict(ei.search_attributes),
-            )
-
-        return self._read_state(task, read)
+        return self._read_state(
+            task, lambda ms: open_visibility_record(task, ms)
+        )
 
     def _process_record_started(self, task: TransferTask) -> None:
         rec = self._open_visibility_record(task)

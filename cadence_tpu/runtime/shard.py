@@ -110,6 +110,27 @@ class ShardContext:
             self._info.timer_ack_level = level
             self._update()
 
+    def ensure_cluster_ack_levels(self, cluster: str) -> None:
+        """Checkpoint the standby cursors at standby-plane construction.
+        Without a persisted per-cluster level the getters would fall
+        back to the LIVE active ack level — which moves past standby-
+        owned tasks, letting queue GC delete rows the standby never
+        verified and making a failover rewind a no-op."""
+        with self._lock:
+            changed = False
+            if cluster not in self._info.cluster_transfer_ack_level:
+                self._info.cluster_transfer_ack_level[cluster] = (
+                    self._info.transfer_ack_level
+                )
+                changed = True
+            if cluster not in self._info.cluster_timer_ack_level:
+                self._info.cluster_timer_ack_level[cluster] = (
+                    self._info.timer_ack_level
+                )
+                changed = True
+            if changed:
+                self._update()
+
     def get_cluster_transfer_ack_level(self, cluster: str) -> int:
         """Per-remote-cluster standby cursor; falls back to the shard's
         own transfer ack level (ref shardContext.go clusterTransferAckLevel)."""

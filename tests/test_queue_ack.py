@@ -1,0 +1,68 @@
+"""QueueAckManager invariants: the ack sweep must never pass a task
+that was read but not processed (deferred holds), and cursor
+checkpoints must not race rewinds.
+
+Reference: service/history/queueAckMgr.go + the standby/failover
+machinery built on it.
+"""
+
+from __future__ import annotations
+
+import time
+
+from cadence_tpu.runtime.queues.ack import QueueAckManager
+
+
+def test_deferred_entry_blocks_sweep():
+    """A held (deferred) task pins the ack level even when later tasks
+    complete — otherwise queue GC would delete the held row."""
+    ack = QueueAckManager(0)
+    assert ack.add(5)
+    assert ack.add(6)
+    ack.defer(5, delay_s=10.0)   # held; retry far in the future
+    ack.complete(6)
+    assert ack.update_ack_level() == 0
+    assert 5 > ack.ack_level
+
+
+def test_deferred_entry_retries_after_delay():
+    ack = QueueAckManager(0)
+    assert ack.add(5)
+    ack.defer(5, delay_s=0.02)
+    assert not ack.add(5)        # still parked
+    time.sleep(0.08)
+    assert ack.add(5)            # retry window open: re-taken
+    ack.complete(5)
+    assert ack.update_ack_level() == 5
+
+
+def test_add_rejects_acked_frontier_key():
+    ack = QueueAckManager(0)
+    assert ack.add(3)
+    ack.complete(3)
+    ack.update_ack_level()
+    assert not ack.add(3)        # frontier row re-read: already done
+
+
+def test_rewind_drops_unswept_completions_and_persists():
+    persisted = []
+    ack = QueueAckManager(0, update_shard_ack=persisted.append)
+    for k in (1, 2, 3):
+        ack.add(k)
+        ack.complete(k)
+    ack.update_ack_level()
+    assert persisted[-1] == 3
+    # completed-but-unswept entries above the rewound level
+    ack.add(10)
+    ack.complete(10)
+    ack.rewind(1)
+    assert persisted[-1] == 1
+    assert ack.update_ack_level() == 1   # 10 must NOT sweep the level up
+    assert ack.add(10)                   # and is re-readable
+
+
+def test_rewind_noop_when_not_behind():
+    persisted = []
+    ack = QueueAckManager(5, update_shard_ack=persisted.append)
+    ack.rewind(7)
+    assert ack.ack_level == 5 and not persisted
