@@ -48,6 +48,7 @@ class WorkflowHandler:
         rate_limiter: Optional[MultiStageRateLimiter] = None,
         version_checker: Optional[ClientVersionChecker] = None,
         blob_size_limit: int = _DEFAULT_BLOB_LIMIT,
+        metrics=None,
     ) -> None:
         self.domain_handler = domain_handler
         self.domains = domain_cache
@@ -58,6 +59,16 @@ class WorkflowHandler:
             global_rps=100000.0, domain_rps=lambda domain: 100000.0
         )
         self.versions = version_checker or ClientVersionChecker()
+        # per-API requests/latency/errors (ref common/metrics/defs.go
+        # frontend scopes, applied as in the scoped metrics clients)
+        from cadence_tpu.utils.metrics import NOOP
+        from cadence_tpu.utils.metrics_defs import (
+            FRONTEND_OPS,
+            instrument_methods,
+        )
+
+        self.metrics = (metrics or NOOP).tagged(service="frontend")
+        instrument_methods(self, self.metrics, FRONTEND_OPS)
 
     # -- request plumbing ----------------------------------------------
 

@@ -63,6 +63,14 @@ class MatchingEngine:
         self._time = time_source or RealTimeSource()
         self._log = get_logger("cadence_tpu.matching")
         self.metrics = metrics.tagged(service="matching")
+        # per-API requests/latency/errors (ref common/metrics/defs.go
+        # matching scopes)
+        from cadence_tpu.utils.metrics_defs import (
+            MATCHING_OPS,
+            instrument_methods,
+        )
+
+        instrument_methods(self, self.metrics, MATCHING_OPS)
         self._lock = threading.Lock()
         self._managers: Dict[tuple, TaskListManager] = {}
         self._pollers: Dict[tuple, PollerHistory] = {}

@@ -33,6 +33,7 @@ class WorkflowExecutionContext:
         workflow_id: str,
         run_id: str,
         on_persist=None,
+        events_cache=None,
     ) -> None:
         self.shard = shard
         self.domain_id = domain_id
@@ -43,6 +44,48 @@ class WorkflowExecutionContext:
         self._condition = 0
         # invoked after every durable write (historyEventNotifier feed)
         self._on_persist = on_persist or (lambda ms: None)
+        # shard-level event LRU (engine/events_cache.py); None in bare
+        # test harnesses — get_event then always pages history
+        self.events_cache = events_cache
+
+    def _drain_cached_events(self, ms: MutableState, run_id: str = "") -> None:
+        """Move transition-written events (activity scheduled, child
+        initiated, ...) into the shard events cache, keeping the
+        mutable state bounded (ref eventsCache.go putEvent)."""
+        if self.events_cache is not None:
+            for e in ms.cached_events:
+                self.events_cache.put(
+                    self.domain_id, self.workflow_id,
+                    run_id or self.run_id, e,
+                )
+        ms.cached_events.clear()
+
+    def get_event(
+        self, ms: MutableState, event_id: int, first_event_id: int = 1
+    ):
+        """Event lookup: staged → shard cache → history branch
+        (ref eventsCache.go getEvent's history fallback)."""
+        for e in ms.cached_events:
+            if e.event_id == event_id:
+                return e
+        if self.events_cache is not None:
+            hit = self.events_cache.get(
+                self.domain_id, self.workflow_id, self.run_id, event_id
+            )
+            if hit is not None:
+                return hit
+        history, _ = self.read_history(ms, first_event_id=first_event_id)
+        for e in history:
+            if e.event_id == event_id:
+                # cache only the requested event — inserting the whole
+                # page would let one deep-history lookup evict the
+                # shard cache's hot entries
+                if self.events_cache is not None:
+                    self.events_cache.put(
+                        self.domain_id, self.workflow_id, self.run_id, e
+                    )
+                return e
+        return None
 
     # -- load ---------------------------------------------------------
 
@@ -175,6 +218,7 @@ class WorkflowExecutionContext:
         )
         self._ms = ms
         self._condition = ms.next_event_id
+        self._drain_cached_events(ms)
         self._on_persist(ms)
 
     def update_workflow(
@@ -230,6 +274,9 @@ class WorkflowExecutionContext:
             new_snapshot=new_snapshot,
         )
         self._condition = ms.next_event_id
+        self._drain_cached_events(ms)
+        if result.new_run_ms is not None:
+            self._drain_cached_events(result.new_run_ms, run_id=new_run_id)
         self._on_persist(ms)
 
     # -- reads --------------------------------------------------------

@@ -54,6 +54,7 @@ from ..persistence.records import CreateWorkflowMode
 from ..shard import ShardContext
 from .cache import HistoryCache
 from .context import WorkflowExecutionContext
+from .events_cache import EventsCache
 from .decision_handler import DecisionFailure, DecisionTaskHandler
 from .notifier import HistoryEventNotifier
 from .query import QueryRegistry
@@ -75,13 +76,23 @@ class HistoryEngine:
         self.metrics = metrics.tagged(service="history", shard=str(shard.shard_id))
         self.log = get_logger("cadence_tpu.history", shard=shard.shard_id)
         self.event_notifier = HistoryEventNotifier()
+        self.events_cache = EventsCache()
         self.cache = HistoryCache(
             lambda d, w, r: WorkflowExecutionContext(
-                shard, d, w, r, on_persist=self._publish_progress
+                shard, d, w, r, on_persist=self._publish_progress,
+                events_cache=self.events_cache,
             )
         )
         self.query_registry = QueryRegistry()
         self.matching_client = None  # wired by the service for queries
+        # per-API requests/latency/errors (ref common/metrics/defs.go
+        # history scopes)
+        from cadence_tpu.utils.metrics_defs import (
+            HISTORY_OPS,
+            instrument_methods,
+        )
+
+        instrument_methods(self, self.metrics, HISTORY_OPS)
         # queue processors poke these after each persisted transaction
         self._task_notifier = task_notifier or (lambda: None)
         self._timer_notifier = timer_notifier or (lambda: None)
@@ -608,18 +619,12 @@ class HistoryEngine:
                 )
                 result = txn.close()
                 ctx.update_workflow(ms, result)
-            # the poll response needs the scheduled event's payload; the
-            # events cache only helps within one process lifetime, so fall
-            # back to the history branch
-            scheduled_event = next(
-                (e for e in ms.cached_events if e.event_id == schedule_id),
-                None,
+            # the poll response needs the scheduled event's payload:
+            # events cache first, history branch on miss
+            scheduled_event = ctx.get_event(
+                ms, schedule_id,
+                first_event_id=max(1, ai.scheduled_event_batch_id),
             )
-            if scheduled_event is None:
-                history, _ = ctx.read_history(ms)
-                scheduled_event = next(
-                    (e for e in history if e.event_id == schedule_id), None
-                )
             return {
                 "activity_id": ai.activity_id,
                 "scheduled_time": ai.scheduled_time,

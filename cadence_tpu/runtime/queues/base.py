@@ -13,13 +13,31 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional
 
+import contextlib
+import time as _time
+
 from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
+from cadence_tpu.utils.metrics import NOOP, Scope
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, defer_task
 
 _TASK_RETRY_COUNT = 3
+
+
+@contextlib.contextmanager
+def timed_task(metrics: Scope, task):
+    """Standard queue-task triple, tagged by task type: requests counter
+    on entry, latency timer on exit; the yielded scope takes the error
+    counter (shared by the transfer/timer/standby pipelines)."""
+    scope = metrics.tagged(task_type=str(getattr(task, "task_type", "?")))
+    scope.inc("task_requests")
+    t0 = _time.perf_counter()
+    try:
+        yield scope
+    finally:
+        scope.record("task_latency", _time.perf_counter() - t0)
 
 
 class QueueProcessorBase:
@@ -34,6 +52,7 @@ class QueueProcessorBase:
         worker_count: int = 4,
         batch_size: int = 64,
         poll_interval_s: float = 0.05,
+        metrics: Optional[Scope] = None,
     ) -> None:
         self.name = name
         self.ack = ack
@@ -44,6 +63,9 @@ class QueueProcessorBase:
         self._batch_size = batch_size
         self._poll_interval = poll_interval_s
         self._log = get_logger(f"cadence_tpu.queue.{name}")
+        self._metrics = (metrics or NOOP).tagged(
+            service="history_queue", queue=name
+        )
         self._notify = threading.Event()
         self._stopped = threading.Event()
         self._pool = ThreadPoolExecutor(
@@ -103,23 +125,25 @@ class QueueProcessorBase:
                 return
 
     def _run_task(self, task, key) -> None:
-        for attempt in range(_TASK_RETRY_COUNT):
-            if self._stopped.is_set():
-                return
-            try:
-                self._process_task(task)
-                break
-            except DeferTask:
-                defer_task(self.ack, key)
-                return
-            except EntityNotExistsServiceError:
-                break  # stale task: workflow/decision moved on
-            except Exception:
-                if attempt == _TASK_RETRY_COUNT - 1:
-                    self._log.exception(
-                        f"queue {self.name} task {key} dropped after "
-                        f"{_TASK_RETRY_COUNT} attempts"
-                    )
+        with timed_task(self._metrics, task) as scope:
+            for attempt in range(_TASK_RETRY_COUNT):
+                if self._stopped.is_set():
+                    return
+                try:
+                    self._process_task(task)
+                    break
+                except DeferTask:
+                    defer_task(self.ack, key)
+                    return
+                except EntityNotExistsServiceError:
+                    break  # stale task: workflow/decision moved on
+                except Exception:
+                    scope.inc("task_errors")
+                    if attempt == _TASK_RETRY_COUNT - 1:
+                        self._log.exception(
+                            f"queue {self.name} task {key} dropped after "
+                            f"{_TASK_RETRY_COUNT} attempts"
+                        )
         try:
             self._complete_task(task)
         except Exception:

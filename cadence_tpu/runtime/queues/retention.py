@@ -37,3 +37,8 @@ def delete_workflow_retention(shard, engine, task) -> None:
         except Exception:
             pass
     engine.cache.evict(task.domain_id, task.workflow_id, task.run_id)
+    events_cache = getattr(engine, "events_cache", None)
+    if events_cache is not None:
+        events_cache.delete_workflow(
+            task.domain_id, task.workflow_id, task.run_id
+        )

@@ -40,13 +40,20 @@ class TimerQueueProcessor:
         worker_count: int = 4,
         batch_size: int = 64,
         standby_clusters=(),
+        metrics=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
         self.matching = matching
         self.standby_clusters = frozenset(standby_clusters)
         self.has_standby = bool(self.standby_clusters)
+        self._injected_metrics = metrics
         self._log = get_logger("cadence_tpu.queue.timer", shard=shard.shard_id)
+        from cadence_tpu.utils.metrics import NOOP
+
+        self._metrics = (self._injected_metrics or NOOP).tagged(
+            service="history_queue", queue=f"timer-{shard.shard_id}"
+        )
         self.ack = QueueAckManager(
             (shard.get_timer_ack_level(), 0),
             update_shard_ack=lambda lvl: shard.update_timer_ack_level(lvl[0]),
@@ -124,23 +131,27 @@ class TimerQueueProcessor:
     _TASK_RETRY_COUNT = 3
 
     def _run_task(self, task: TimerTask, key) -> None:
-        for attempt in range(self._TASK_RETRY_COUNT):
-            if self._stopped.is_set():
-                return
-            try:
-                self._process(task)
-                break
-            except DeferTask:
-                defer_task(self.ack, key)
-                return
-            except EntityNotExistsServiceError:
-                break  # workflow gone / state moved on: stale timer
-            except Exception:
-                if attempt == self._TASK_RETRY_COUNT - 1:
-                    self._log.exception(
-                        f"timer task {key} ({task.task_type}) dropped after "
-                        f"{self._TASK_RETRY_COUNT} attempts"
-                    )
+        from .base import timed_task
+
+        with timed_task(self._metrics, task) as scope:
+            for attempt in range(self._TASK_RETRY_COUNT):
+                if self._stopped.is_set():
+                    return
+                try:
+                    self._process(task)
+                    break
+                except DeferTask:
+                    defer_task(self.ack, key)
+                    return
+                except EntityNotExistsServiceError:
+                    break  # workflow gone / state moved on: stale timer
+                except Exception:
+                    scope.inc("task_errors")
+                    if attempt == self._TASK_RETRY_COUNT - 1:
+                        self._log.exception(
+                            f"timer task {key} ({task.task_type}) dropped "
+                            f"after {self._TASK_RETRY_COUNT} attempts"
+                        )
         if not self.has_standby:   # with standby planes, QueueGC deletes
             try:
                 self.shard.persistence.execution.complete_timer_task(

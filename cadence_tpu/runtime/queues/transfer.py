@@ -376,21 +376,18 @@ class TransferQueueProcessor(QueueProcessorBase):
         )
 
     def _process_start_child(self, task: TransferTask) -> None:
-        # (processStartChildExecution: read initiated attrs, start the
-        # child with parent linkage, record started/failed in the parent)
-        def read(ms):
+        # (processStartChildExecution: read initiated attrs from the
+        # events cache — history branch on miss — then start the child
+        # with parent linkage, record started/failed in the parent)
+        def read(ctx, ms):
             ci = ms.get_child_execution_info(task.initiated_id)
             if ci is None:
                 return None
             if ci.started_id != EMPTY_EVENT_ID:
                 return {"already_started": True, "ci": ci}
-            initiated = next(
-                (
-                    e
-                    for e in ms.cached_events
-                    if e.event_id == task.initiated_id
-                ),
-                None,
+            initiated = ctx.get_event(
+                ms, task.initiated_id,
+                first_event_id=max(1, ci.initiated_event_batch_id),
             )
             return {
                 "already_started": False,
@@ -400,15 +397,17 @@ class TransferQueueProcessor(QueueProcessorBase):
                 else None,
             }
 
-        snap = self._read_state(task, read)
+        try:
+            snap = self.engine.with_workflow(
+                task.domain_id, task.workflow_id, task.run_id, read
+            )
+        except EntityNotExistsServiceError:
+            return
         if snap is None or snap["already_started"]:
             return
         attrs = snap["initiated_attrs"]
         if attrs is None:
-            # events cache miss: fall back to the history branch
-            attrs = self._initiated_attrs_from_history(task)
-            if attrs is None:
-                return
+            return
         ci = snap["ci"]
         child_domain = self.engine.domains.resolve(
             attrs.get("domain") or ci.domain_name or task.domain_id
@@ -458,27 +457,6 @@ class TransferQueueProcessor(QueueProcessorBase):
             task.initiated_id, child_domain_name,
             request.workflow_id, child_run_id, request.workflow_type,
         )
-
-    def _initiated_attrs_from_history(self, task: TransferTask):
-        def read(ctx, ms):
-            ci = ms.get_child_execution_info(task.initiated_id)
-            first = (
-                max(1, ci.initiated_event_batch_id)
-                if ci is not None
-                else max(1, task.initiated_id)
-            )
-            history, _ = ctx.read_history(ms, first_event_id=first)
-            ev = next(
-                (e for e in history if e.event_id == task.initiated_id), None
-            )
-            return dict(ev.attributes) if ev is not None else None
-
-        try:
-            return self.engine.with_workflow(
-                task.domain_id, task.workflow_id, task.run_id, read
-            )
-        except EntityNotExistsServiceError:
-            return None
 
     def _open_visibility_record(self, task: TransferTask):
         return self._read_state(

@@ -1,0 +1,181 @@
+"""Events cache (ref eventsCache.go:66-148) + per-API scoped metrics
+(ref common/metrics/defs.go applied via scoped clients)."""
+
+from __future__ import annotations
+
+import pytest
+
+from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.runtime.engine.events_cache import EventsCache
+from cadence_tpu.utils.metrics import NOOP
+
+
+def _ev(eid: int) -> HistoryEvent:
+    return HistoryEvent(
+        event_id=eid, event_type=EventType.ActivityTaskScheduled,
+        version=1, timestamp=0, attributes={"activity_id": str(eid)},
+    )
+
+
+class TestEventsCache:
+    def test_put_get_lru(self):
+        c = EventsCache(max_entries=2)
+        c.put("d", "w", "r", _ev(1))
+        c.put("d", "w", "r", _ev(2))
+        assert c.get("d", "w", "r", 1).event_id == 1   # 1 now most-recent
+        c.put("d", "w", "r", _ev(3))                    # evicts 2
+        assert c.get("d", "w", "r", 2) is None
+        assert c.get("d", "w", "r", 1) is not None
+        assert c.get("d", "w", "r", 3) is not None
+
+    def test_delete_workflow(self):
+        c = EventsCache()
+        c.put("d", "w", "r1", _ev(1))
+        c.put("d", "w", "r2", _ev(1))
+        c.delete_workflow("d", "w", "r1")
+        assert c.get("d", "w", "r1", 1) is None
+        assert c.get("d", "w", "r2", 1) is not None
+
+
+class TestWiredThroughEngine:
+    def test_transaction_drains_into_cache(self):
+        """After a persisted transaction the staged cached_events move
+        to the shard events cache and the mutable state stays bounded;
+        a fresh context (cache cleared) still resolves the scheduled
+        event through get_event's history fallback."""
+        from cadence_tpu.client import HistoryClient, MatchingClient
+        from cadence_tpu.matching import MatchingEngine
+        from cadence_tpu.runtime.api import Decision, StartWorkflowRequest
+        from cadence_tpu.core.enums import DecisionType
+        from cadence_tpu.runtime.domains import DomainCache, register_domain
+        from cadence_tpu.runtime.membership import single_host_monitor
+        from cadence_tpu.runtime.persistence.memory import (
+            create_memory_bundle,
+        )
+        from cadence_tpu.runtime.service import HistoryService
+
+        bundle = create_memory_bundle()
+        domain_id = register_domain(bundle.metadata, "ec-dom")
+        domains = DomainCache(bundle.metadata)
+        hist = HistoryService(1, bundle, domains,
+                              single_host_monitor("ec-host"))
+        hc = HistoryClient(hist.controller)
+        matching = MatchingEngine(bundle.task, hc)
+        hist.wire(MatchingClient(matching), hc)
+        hist.start()
+        try:
+            engine = hist.controller.get_engine_for_shard(0)
+            run_id = engine.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain="ec-dom", workflow_id="ec-wf",
+                    workflow_type="t", task_list="tl",
+                    execution_start_to_close_timeout_seconds=60,
+                ),
+                domain_id=domain_id,
+            )
+            task = engine.record_decision_task_started(
+                domain_id, "ec-wf", run_id, 2, "req", "w"
+            )
+            engine.respond_decision_task_completed(
+                {"domain_id": domain_id, "workflow_id": "ec-wf",
+                 "run_id": run_id, "schedule_id": 2},
+                [Decision(DecisionType.ScheduleActivityTask, {
+                    "activity_id": "a1", "activity_type": "at",
+                    "task_list": "tl",
+                    "schedule_to_close_timeout_seconds": 30,
+                    "schedule_to_start_timeout_seconds": 10,
+                    "start_to_close_timeout_seconds": 20,
+                })],
+            )
+            ctx = engine.cache.get_or_create(domain_id, "ec-wf", run_id)
+            with ctx.lock:
+                ms = ctx.load()
+                # staged list drained into the shard cache
+                assert ms.cached_events == []
+                sched_id = next(iter(ms.pending_activities))
+                hit = engine.events_cache.get(
+                    domain_id, "ec-wf", run_id, sched_id
+                )
+                assert hit is not None
+                assert hit.event_type == EventType.ActivityTaskScheduled
+
+                # simulate restart: empty cache → history fallback
+                engine.events_cache._entries.clear()
+                ev = ctx.get_event(ms, sched_id)
+                assert ev is not None
+                assert ev.event_type == EventType.ActivityTaskScheduled
+        finally:
+            hist.stop()
+            matching.shutdown()
+
+
+class TestScopedMetrics:
+    def test_per_api_triple_recorded(self):
+        from cadence_tpu.utils.metrics_defs import instrument_methods
+
+        scope = NOOP.tagged(service="test-svc")
+
+        class H:
+            def op_ok(self):
+                return 1
+
+            def op_fail(self):
+                raise ValueError("x")
+
+        h = H()
+        instrument_methods(h, scope, ("op_ok", "op_fail", "op_missing"))
+        assert h.op_ok() == 1
+        with pytest.raises(ValueError):
+            h.op_fail()
+        reg = NOOP.registry
+        tags_ok = {"service": "test-svc", "operation": "op_ok"}
+        tags_fail = {"service": "test-svc", "operation": "op_fail"}
+        assert reg.counter_value("requests", tags_ok) == 1
+        assert reg.counter_value("errors", tags_ok) == 0
+        assert reg.counter_value("errors", tags_fail) == 1
+        assert reg.timer_stats("latency", tags_ok)[0] == 1
+
+    def test_engine_apis_instrumented(self):
+        from cadence_tpu.runtime.domains import DomainCache, register_domain
+        from cadence_tpu.runtime.membership import single_host_monitor
+        from cadence_tpu.runtime.persistence.memory import (
+            create_memory_bundle,
+        )
+        from cadence_tpu.runtime.service import HistoryService
+        from cadence_tpu.client import HistoryClient, MatchingClient
+        from cadence_tpu.matching import MatchingEngine
+        from cadence_tpu.runtime.api import StartWorkflowRequest
+
+        bundle = create_memory_bundle()
+        register_domain(bundle.metadata, "m-dom")
+        domains = DomainCache(bundle.metadata)
+        hist = HistoryService(1, bundle, domains,
+                              single_host_monitor("m-host"))
+        hc = HistoryClient(hist.controller)
+        matching = MatchingEngine(bundle.task, hc)
+        hist.wire(MatchingClient(matching), hc)
+        hist.start()
+        try:
+            engine = hist.controller.get_engine_for_shard(0)
+            before = NOOP.registry.counter_value(
+                "requests",
+                {"service": "history", "shard": "0",
+                 "operation": "start_workflow_execution"},
+            )
+            engine.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain="m-dom", workflow_id="m-wf", workflow_type="t",
+                    task_list="tl",
+                    execution_start_to_close_timeout_seconds=60,
+                ),
+            )
+            after = NOOP.registry.counter_value(
+                "requests",
+                {"service": "history", "shard": "0",
+                 "operation": "start_workflow_execution"},
+            )
+            assert after == before + 1
+        finally:
+            hist.stop()
+            matching.shutdown()

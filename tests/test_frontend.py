@@ -255,6 +255,8 @@ class TestWorkflowAPI:
 
 class TestVisibility:
     def _seed(self, fb):
+        """Returns the workflow_type of the run that was completed (the
+        single poll takes whichever task dispatched first)."""
         for i in range(3):
             fb.frontend.start_workflow_execution(
                 StartWorkflowRequest(
@@ -281,7 +283,7 @@ class TestVisibility:
                 "fe-domain"
             )
             if closed:
-                return
+                return task.workflow_type
             time.sleep(0.05)
         raise AssertionError("close visibility record never appeared")
 
@@ -295,13 +297,16 @@ class TestVisibility:
         assert len(closed_recs) == 1
 
     def test_query_language(self, fb):
-        self._seed(fb)
+        # which run completes depends on dispatch order; expectations
+        # key off the completed run's type
+        completed_type = self._seed(fb)
         recs, _ = fb.frontend.list_workflow_executions(
             "fe-domain", "WorkflowType = 'typeA'"
         )
         assert len(recs) == 2
         recs, _ = fb.frontend.list_workflow_executions(
-            "fe-domain", "WorkflowType = 'typeA' AND CloseStatus = 'COMPLETED'"
+            "fe-domain",
+            f"WorkflowType = '{completed_type}' AND CloseStatus = 'COMPLETED'",
         )
         assert len(recs) == 1
         recs, _ = fb.frontend.list_workflow_executions(
