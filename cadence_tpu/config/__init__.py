@@ -1,0 +1,33 @@
+"""Static server configuration (YAML) + service bootstrap.
+
+Reference: common/service/config/config.go (the YAML config structs:
+persistence, ringpop, per-service rpc/metrics, clusterMetadata,
+archival, dynamicconfig) and cmd/server/server.go:207-219 (the
+--services switch assembling only the requested services in one
+process). See config/development.yaml for a sample.
+"""
+
+from .static import (
+    ClusterConfig,
+    ClusterEntry,
+    PersistenceConfig,
+    RingConfig,
+    ServerConfig,
+    ServiceConfig,
+    load_config,
+    load_config_dict,
+)
+from .bootstrap import RunningServer, start_services
+
+__all__ = [
+    "ClusterConfig",
+    "ClusterEntry",
+    "PersistenceConfig",
+    "RingConfig",
+    "ServerConfig",
+    "ServiceConfig",
+    "RunningServer",
+    "load_config",
+    "load_config_dict",
+    "start_services",
+]

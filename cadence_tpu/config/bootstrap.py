@@ -1,0 +1,202 @@
+"""Service bootstrap: config + --services → a running host.
+
+Reference: cmd/server/server.go:207-219 — one process starts only the
+requested services; every service resolves its peers through the ring
+(bootstrap hosts from config) and the cross-process gRPC plane
+(rpc/server.py, client/routed.py). A host running only `frontend`
+reaches remote history/matching hosts exactly as the reference's
+stateless frontends do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from .static import SERVICES, ConfigError, ServerConfig
+
+
+@dataclasses.dataclass
+class RunningServer:
+    config: ServerConfig
+    services: List[str]
+    persistence: object
+    domains: object
+    monitor: object
+    frontend: object = None
+    admin: object = None
+    history: object = None
+    matching: object = None
+    worker: object = None
+    domain_handler: object = None
+    history_client: object = None
+    matching_client: object = None
+    rpc_servers: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @property
+    def addresses(self) -> Dict[str, str]:
+        return {name: s.address for name, s in self.rpc_servers.items()}
+
+    def stop(self) -> None:
+        for s in self.rpc_servers.values():
+            s.stop()
+        if self.worker is not None:
+            self.worker.stop()
+        if self.history is not None:
+            self.history.stop()
+        if self.matching is not None:
+            self.matching.shutdown()
+        for client in (self.history_client, self.matching_client):
+            close = getattr(client, "close", None)
+            if close:
+                close()
+
+
+def _build_persistence(cfg: ServerConfig):
+    if cfg.persistence.default_store == "sqlite":
+        from cadence_tpu.runtime.persistence.sqlite import (
+            create_sqlite_bundle,
+        )
+
+        return create_sqlite_bundle(
+            cfg.persistence.sqlite_path,
+            auto_setup=cfg.persistence.auto_setup_schema,
+        )
+    from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+
+    return create_memory_bundle()
+
+
+def start_services(
+    cfg: ServerConfig,
+    services: Optional[List[str]] = None,
+    persistence=None,
+) -> RunningServer:
+    """Assemble and start the requested services (default: all)."""
+    from cadence_tpu.client import (
+        RoutedHistoryClient,
+        RoutedMatchingClient,
+    )
+    from cadence_tpu.frontend import (
+        AdminHandler,
+        DomainHandler,
+        WorkflowHandler,
+    )
+    from cadence_tpu.matching import MatchingEngine
+    from cadence_tpu.runtime.domains import DomainCache
+    from cadence_tpu.runtime.membership import Monitor
+    from cadence_tpu.runtime.service import HistoryService
+    from cadence_tpu.rpc.server import (
+        FrontendRPCServer,
+        HistoryRPCServer,
+        MatchingRPCServer,
+    )
+
+    services = list(services or SERVICES)
+    for s in services:
+        if s not in SERVICES:
+            raise ConfigError(f"unknown service '{s}'")
+
+    persistence = persistence or _build_persistence(cfg)
+    domains = DomainCache(persistence.metadata)
+    cluster_metadata = cfg.build_cluster_metadata()
+
+    # the host's ring identity per service is its rpc bind address;
+    # bootstrap hosts from config pre-populate the rings so a partial
+    # host set still routes to its peers
+    def addr(service: str) -> str:
+        sc = cfg.services.get(service)
+        return sc.rpc_address if sc else "127.0.0.1:0"
+
+    monitor = Monitor(self_identity=addr("history"))
+    for service, hosts in cfg.ring.bootstrap_hosts.items():
+        monitor.resolver(service).set_hosts(list(hosts))
+    for service in services:
+        monitor.join(service, addr(service))
+
+    out = RunningServer(
+        config=cfg, services=services, persistence=persistence,
+        domains=domains, monitor=monitor,
+    )
+    out.domain_handler = DomainHandler(
+        persistence.metadata, cluster_metadata
+    )
+
+    history = None
+    if "history" in services:
+        history = HistoryService(
+            cfg.persistence.num_history_shards, persistence, domains,
+            monitor, cluster_metadata=cluster_metadata,
+        )
+        out.history = history
+
+    hc = RoutedHistoryClient(
+        monitor,
+        history.controller if history else None,
+        num_shards=cfg.persistence.num_history_shards,
+    )
+    out.history_client = hc
+
+    matching = None
+    if "matching" in services:
+        matching = MatchingEngine(persistence.task, hc)
+        out.matching = matching
+    mc = RoutedMatchingClient(
+        monitor, matching, local_identity=addr("matching")
+    )
+    out.matching_client = mc
+
+    if history is not None:
+        history.wire(mc, hc)
+        history.start()
+        out.rpc_servers["history"] = HistoryRPCServer(
+            history, address=addr("history")
+        ).start()
+    if matching is not None:
+        out.rpc_servers["matching"] = MatchingRPCServer(
+            matching, address=addr("matching")
+        ).start()
+
+    if "frontend" in services:
+        visibility = None
+        if persistence.visibility is not None:
+            from cadence_tpu.visibility import AdvancedVisibilityStore
+
+            visibility = AdvancedVisibilityStore(persistence.visibility)
+        out.frontend = WorkflowHandler(
+            out.domain_handler, domains, hc, mc, visibility=visibility
+        )
+        out.admin = (
+            AdminHandler(history, domains) if history is not None else None
+        )
+        out.rpc_servers["frontend"] = FrontendRPCServer(
+            out.frontend, out.admin, address=addr("frontend")
+        ).start()
+
+    if "worker" in services:
+        from cadence_tpu.worker.service import WorkerService
+
+        worker_frontend = out.frontend
+        if worker_frontend is None:
+            # worker-only host: drive system workflows through a REMOTE
+            # frontend (the reference's worker runs against the public
+            # API, service/worker/service.go)
+            fe_addr = addr("frontend")
+            if fe_addr.endswith(":0"):
+                raise ConfigError(
+                    "worker without a local frontend needs "
+                    "services.frontend.rpcAddress pointing at a "
+                    "frontend host"
+                )
+            from cadence_tpu.rpc.client import RemoteFrontend
+
+            worker_frontend = RemoteFrontend(fe_addr)
+        out.worker = WorkerService(
+            worker_frontend, persistence,
+            num_shards=cfg.persistence.num_history_shards,
+            domain_handler=out.domain_handler,
+            history_service=history,
+        )
+        out.worker.start()
+
+    return out

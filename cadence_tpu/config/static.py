@@ -1,0 +1,214 @@
+"""YAML config structs + loader.
+
+Reference: common/service/config/config.go — the static (per-env YAML)
+half of the config system; the hot-reload half is
+utils/dynamicconfig.py. Unknown keys are rejected so a typo'd config
+fails at boot, matching the reference's strict yaml decoding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+SERVICES = ("frontend", "history", "matching", "worker")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class PersistenceConfig:
+    """ref config.go Persistence: defaultStore + numHistoryShards; the
+    datastore plugins here are 'memory' and 'sqlite'."""
+
+    default_store: str = "memory"        # memory | sqlite
+    sqlite_path: str = ""                # required for sqlite
+    num_history_shards: int = 4
+    # True (dev/onebox): bring the schema to current at boot.
+    # False (production): boot REFUSES to start unless the database is
+    # already at this build's schema version — the operator runs
+    # `cadence-tpu schema update` explicitly (ref cmd/server/cadence.go:66)
+    auto_setup_schema: bool = True
+
+    def validate(self) -> None:
+        if self.default_store not in ("memory", "sqlite"):
+            raise ConfigError(
+                f"persistence.default_store: unknown store "
+                f"'{self.default_store}'"
+            )
+        if self.default_store == "sqlite" and not self.sqlite_path:
+            raise ConfigError("persistence.sqlite_path required for sqlite")
+        if self.num_history_shards < 1:
+            raise ConfigError("persistence.num_history_shards must be >= 1")
+
+
+@dataclasses.dataclass
+class ServiceConfig:
+    """ref config.go Service{RPC, Metrics, PProf} — the rpc bind
+    address doubles as the host's ring identity."""
+
+    rpc_address: str = "127.0.0.1:0"
+
+
+@dataclasses.dataclass
+class RingConfig:
+    """ref config.go Ringpop (bootstrapHosts): static host lists per
+    service ring; identities are dial addresses."""
+
+    bootstrap_hosts: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class ClusterEntry:
+    initial_failover_version: int = 0
+    enabled: bool = True
+    rpc_address: str = ""
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """ref config.go ClusterMetadata."""
+
+    enable_global_domain: bool = False
+    failover_version_increment: int = 10
+    master_cluster_name: str = ""
+    current_cluster_name: str = ""
+    cluster_info: Dict[str, ClusterEntry] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def validate(self) -> None:
+        if not self.cluster_info:
+            return
+        for name in (self.master_cluster_name, self.current_cluster_name):
+            if name and name not in self.cluster_info:
+                raise ConfigError(
+                    f"clusterMetadata: cluster '{name}' not in cluster_info"
+                )
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    persistence: PersistenceConfig = dataclasses.field(
+        default_factory=PersistenceConfig
+    )
+    services: Dict[str, ServiceConfig] = dataclasses.field(
+        default_factory=lambda: {s: ServiceConfig() for s in SERVICES}
+    )
+    ring: RingConfig = dataclasses.field(default_factory=RingConfig)
+    cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    dynamicconfig_path: str = ""
+    archival_dir: str = ""
+
+    def validate(self) -> None:
+        self.persistence.validate()
+        self.cluster.validate()
+        for name in self.services:
+            if name not in SERVICES:
+                raise ConfigError(f"services: unknown service '{name}'")
+
+    def build_cluster_metadata(self):
+        """ClusterMetadata from the config, or None (single cluster)."""
+        if not self.cluster.cluster_info:
+            return None
+        from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+
+        return ClusterMetadata(
+            enable_global_domain=self.cluster.enable_global_domain,
+            failover_version_increment=(
+                self.cluster.failover_version_increment
+            ),
+            master_cluster_name=self.cluster.master_cluster_name,
+            current_cluster_name=self.cluster.current_cluster_name,
+            cluster_info={
+                name: ClusterInformation(
+                    initial_failover_version=e.initial_failover_version,
+                    enabled=e.enabled,
+                    rpc_address=e.rpc_address,
+                )
+                for name, e in self.cluster.cluster_info.items()
+            },
+        )
+
+
+def _take(d: dict, allowed: Dict[str, object], where: str) -> dict:
+    out = {}
+    for k, v in d.items():
+        if k not in allowed:
+            raise ConfigError(f"{where}: unknown key '{k}'")
+        out[allowed[k]] = v  # type: ignore[index]
+    return out
+
+
+def load_config_dict(raw: dict) -> ServerConfig:
+    import copy
+
+    cfg = ServerConfig()
+    # deep copy: parsing pops nested keys and must not mutate the
+    # caller's dict (a shared dict may build several hosts' configs)
+    raw = copy.deepcopy(raw or {})
+
+    p = raw.pop("persistence", None)
+    if p:
+        cfg.persistence = PersistenceConfig(**_take(p, {
+            "defaultStore": "default_store",
+            "sqlitePath": "sqlite_path",
+            "numHistoryShards": "num_history_shards",
+            "autoSetupSchema": "auto_setup_schema",
+        }, "persistence"))
+
+    services = raw.pop("services", None)
+    if services is not None:
+        cfg.services = {}
+        for name, sc in (services or {}).items():
+            cfg.services[name] = ServiceConfig(**_take(sc or {}, {
+                "rpcAddress": "rpc_address",
+            }, f"services.{name}"))
+
+    ring = raw.pop("ring", None)
+    if ring:
+        cfg.ring = RingConfig(**_take(ring, {
+            "bootstrapHosts": "bootstrap_hosts",
+        }, "ring"))
+
+    cm = raw.pop("clusterMetadata", None)
+    if cm:
+        info = cm.pop("clusterInformation", {}) or {}
+        cfg.cluster = ClusterConfig(**_take(cm, {
+            "enableGlobalDomain": "enable_global_domain",
+            "failoverVersionIncrement": "failover_version_increment",
+            "masterClusterName": "master_cluster_name",
+            "currentClusterName": "current_cluster_name",
+        }, "clusterMetadata"))
+        cfg.cluster.cluster_info = {
+            name: ClusterEntry(**_take(e or {}, {
+                "initialFailoverVersion": "initial_failover_version",
+                "enabled": "enabled",
+                "rpcAddress": "rpc_address",
+            }, f"clusterMetadata.clusterInformation.{name}"))
+            for name, e in info.items()
+        }
+
+    dc = raw.pop("dynamicConfig", None)
+    if dc:
+        cfg.dynamicconfig_path = (dc or {}).get("filepath", "")
+
+    arch = raw.pop("archival", None)
+    if arch:
+        cfg.archival_dir = (arch or {}).get("dir", "")
+
+    if raw:
+        raise ConfigError(f"unknown top-level keys: {sorted(raw)}")
+    cfg.validate()
+    return cfg
+
+
+def load_config(path: str) -> ServerConfig:
+    import yaml
+
+    with open(path) as f:
+        return load_config_dict(yaml.safe_load(f) or {})

@@ -49,66 +49,27 @@ from .records import (
     WorkflowSnapshot,
 )
 
-_SCHEMA = """
-CREATE TABLE IF NOT EXISTS shards (
-  shard_id INTEGER PRIMARY KEY, range_id INTEGER NOT NULL, blob TEXT NOT NULL);
-CREATE TABLE IF NOT EXISTS executions (
-  shard_id INTEGER, domain_id TEXT, workflow_id TEXT, run_id TEXT,
-  next_event_id INTEGER NOT NULL, last_write_version INTEGER NOT NULL,
-  snapshot TEXT NOT NULL,
-  PRIMARY KEY (shard_id, domain_id, workflow_id, run_id));
-CREATE TABLE IF NOT EXISTS current_executions (
-  shard_id INTEGER, domain_id TEXT, workflow_id TEXT,
-  run_id TEXT NOT NULL, create_request_id TEXT, state INTEGER,
-  close_status INTEGER, last_write_version INTEGER,
-  PRIMARY KEY (shard_id, domain_id, workflow_id));
-CREATE TABLE IF NOT EXISTS transfer_tasks (
-  shard_id INTEGER, task_id INTEGER, blob TEXT NOT NULL,
-  PRIMARY KEY (shard_id, task_id));
-CREATE TABLE IF NOT EXISTS timer_tasks (
-  shard_id INTEGER, visibility_ts INTEGER, task_id INTEGER, blob TEXT NOT NULL,
-  PRIMARY KEY (shard_id, visibility_ts, task_id));
-CREATE TABLE IF NOT EXISTS replication_tasks (
-  shard_id INTEGER, task_id INTEGER, blob TEXT NOT NULL,
-  PRIMARY KEY (shard_id, task_id));
-CREATE TABLE IF NOT EXISTS history_nodes (
-  tree_id TEXT, branch_id TEXT, node_id INTEGER, txn_id INTEGER, blob BLOB,
-  PRIMARY KEY (tree_id, branch_id, node_id));
-CREATE TABLE IF NOT EXISTS history_branches (
-  tree_id TEXT, branch_id TEXT, token TEXT NOT NULL,
-  PRIMARY KEY (tree_id, branch_id));
-CREATE TABLE IF NOT EXISTS task_lists (
-  domain_id TEXT, name TEXT, task_type INTEGER,
-  range_id INTEGER NOT NULL, ack_level INTEGER NOT NULL, kind INTEGER,
-  last_updated INTEGER,
-  PRIMARY KEY (domain_id, name, task_type));
-CREATE TABLE IF NOT EXISTS tasks (
-  domain_id TEXT, name TEXT, task_type INTEGER, task_id INTEGER,
-  blob TEXT NOT NULL,
-  PRIMARY KEY (domain_id, name, task_type, task_id));
-CREATE TABLE IF NOT EXISTS domains (
-  id TEXT PRIMARY KEY, name TEXT UNIQUE NOT NULL, blob TEXT NOT NULL,
-  notification_version INTEGER NOT NULL);
-CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v INTEGER NOT NULL);
-CREATE TABLE IF NOT EXISTS visibility (
-  domain_id TEXT, workflow_id TEXT, run_id TEXT, is_open INTEGER,
-  start_time INTEGER, close_time INTEGER, close_status INTEGER,
-  workflow_type TEXT, blob TEXT NOT NULL,
-  PRIMARY KEY (domain_id, workflow_id, run_id));
-"""
+# schema DDL lives in schema.py (versioned migrations)
 
 
 class _Db:
     """One shared connection guarded by a lock; transactions via context."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, auto_setup: bool = True) -> None:
+        from .schema import check_compat, update_schema
+
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         # writers from other PROCESSES (two-process service plane) wait
         # instead of failing immediately with SQLITE_BUSY
         self.conn.execute("PRAGMA busy_timeout=5000")
-        self.conn.executescript(_SCHEMA)
-        self.conn.commit()
+        if auto_setup:
+            # embedded/onebox convenience: bring the schema to current
+            update_schema(self.conn)
+        else:
+            # production boot: the operator runs `schema update`
+            # explicitly (ref cmd/server/cadence.go:66 compat gate)
+            check_compat(self.conn)
         self.lock = threading.RLock()
 
     @contextmanager
@@ -984,8 +945,8 @@ class SqliteVisibilityManager(I.VisibilityManager):
 
 
 class SqliteBundle(I.PersistenceBundle):
-    def __init__(self, path: str = ":memory:") -> None:
-        self._db = _Db(path)
+    def __init__(self, path: str = ":memory:", auto_setup: bool = True) -> None:
+        self._db = _Db(path, auto_setup=auto_setup)
         super().__init__(
             shard=SqliteShardManager(self._db),
             execution=SqliteExecutionManager(self._db),
@@ -999,5 +960,7 @@ class SqliteBundle(I.PersistenceBundle):
         self._db.conn.close()
 
 
-def create_sqlite_bundle(path: str = ":memory:") -> I.PersistenceBundle:
-    return SqliteBundle(path)
+def create_sqlite_bundle(
+    path: str = ":memory:", auto_setup: bool = True
+) -> I.PersistenceBundle:
+    return SqliteBundle(path, auto_setup=auto_setup)

@@ -22,8 +22,11 @@ from cadence_tpu.core.timer_sequence import TimerSequence
 from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
 
+from cadence_tpu.utils.metrics import NOOP
+
 from .ack import QueueAckManager
 from .allocator import DeferTask, TaskAllocator, defer_task
+from .base import timed_task
 from .timer_gate import LocalTimerGate
 
 _TIMEOUT_REASON = "cadenceInternal:Timeout"
@@ -47,11 +50,8 @@ class TimerQueueProcessor:
         self.matching = matching
         self.standby_clusters = frozenset(standby_clusters)
         self.has_standby = bool(self.standby_clusters)
-        self._injected_metrics = metrics
         self._log = get_logger("cadence_tpu.queue.timer", shard=shard.shard_id)
-        from cadence_tpu.utils.metrics import NOOP
-
-        self._metrics = (self._injected_metrics or NOOP).tagged(
+        self._metrics = (metrics or NOOP).tagged(
             service="history_queue", queue=f"timer-{shard.shard_id}"
         )
         self.ack = QueueAckManager(
@@ -131,8 +131,6 @@ class TimerQueueProcessor:
     _TASK_RETRY_COUNT = 3
 
     def _run_task(self, task: TimerTask, key) -> None:
-        from .base import timed_task
-
         with timed_task(self._metrics, task) as scope:
             for attempt in range(self._TASK_RETRY_COUNT):
                 if self._stopped.is_set():

@@ -58,6 +58,21 @@ def _frontend(args):
 
 
 def cmd_server(args) -> None:
+    if args.config:
+        conflicting = [
+            flag for flag, default in (
+                ("--db", args.db == ""), ("--port", args.port == 7933),
+                ("--shards", args.shards == 4),
+                ("--no-worker", not args.no_worker),
+            ) if not default
+        ]
+        if conflicting:
+            sys.exit(
+                f"--config conflicts with {', '.join(conflicting)}: "
+                "those settings come from the config file"
+            )
+        _config_server(args)
+        return
     from cadence_tpu.rpc import FrontendRPCServer
     from cadence_tpu.runtime.persistence.sqlite import create_sqlite_bundle
     from cadence_tpu.testing.onebox import Onebox
@@ -84,6 +99,68 @@ def cmd_server(args) -> None:
     finally:
         server.stop()
         box.stop()
+
+
+def _config_server(args) -> None:
+    """Config-driven start (ref cmd/server/server.go:207-219): only the
+    requested services run in this process; peers resolve over the
+    ring + gRPC plane."""
+    from cadence_tpu.config import load_config, start_services
+
+    cfg = load_config(args.config)
+    services = (
+        [s.strip() for s in args.services.split(",") if s.strip()]
+        if args.services else None
+    )
+    server = start_services(cfg, services)
+    print(
+        f"cadence-tpu services {server.services} up; endpoints: "
+        f"{server.addresses}"
+    )
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+
+
+# -- schema ---------------------------------------------------------------
+
+
+def cmd_schema(args) -> None:
+    """Versioned schema tooling (ref tools/cassandra/handler.go
+    setup-schema / update-schema)."""
+    import sqlite3
+
+    from cadence_tpu.runtime.persistence import schema as S
+
+    conn = sqlite3.connect(args.db)
+    try:
+        if args.schema_cmd == "version":
+            _print({
+                "db_version": S.get_schema_version(conn),
+                "build_version": S.CURRENT_SCHEMA_VERSION,
+            })
+        elif args.schema_cmd in ("setup", "update"):
+            applied = S.update_schema(conn)
+            _print({
+                "applied": [
+                    {"version": v, "name": n} for v, n in applied
+                ],
+                "db_version": S.get_schema_version(conn),
+            })
+        elif args.schema_cmd == "check":
+            try:
+                S.check_compat(conn)
+                _print({"compatible": True})
+            except S.SchemaVersionError as e:
+                _print({"compatible": False, "error": str(e)})
+                sys.exit(1)
+    finally:
+        conn.close()
 
 
 # -- domain ---------------------------------------------------------------
@@ -278,12 +355,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="frontend gRPC address (host:port)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    s = sub.add_parser("server", help="run a onebox server")
+    s = sub.add_parser("server", help="run server services")
     s.add_argument("--db", default="", help="sqlite path (default memory)")
     s.add_argument("--port", type=int, default=7933)
     s.add_argument("--shards", type=int, default=4)
     s.add_argument("--no-worker", action="store_true")
+    s.add_argument("--config", default="",
+                   help="static YAML config (enables --services)")
+    s.add_argument("--services", default="",
+                   help="comma list: frontend,history,matching,worker")
     s.set_defaults(fn=cmd_server)
+
+    sc = sub.add_parser("schema", help="versioned sqlite schema tooling")
+    scsub = sc.add_subparsers(dest="schema_cmd", required=True)
+    for name in ("setup", "update", "version", "check"):
+        sp = scsub.add_parser(name)
+        sp.add_argument("--db", required=True)
+    sc.set_defaults(fn=cmd_schema)
 
     d = sub.add_parser("domain")
     dsub = d.add_subparsers(dest="domain_cmd", required=True)
