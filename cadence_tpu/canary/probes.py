@@ -1,9 +1,12 @@
-"""Canary probe workflows (reference canary/: echo.go, signal.go,
-timeout.go, retry.go, concurrentExec.go, query.go, reset.go).
+"""Canary probe workflows.
 
-Each probe is (workflow fn + activities + driver fn); the driver runs
-against any frontend (local handler or gRPC stub) and asserts the
-outcome.
+Reference canary workflow set (canary/const.go:64-84): echo, signal,
+signal.external, visibility, searchAttributes, concurrent-execution,
+query, timeout, localactivity, cancellation, cancellation.external,
+retry, reset.base/reset, cron, sanity (the batch/archival probes drive
+worker subsystems and live with their services). Each probe here is
+(workflow fn + activities + driver fn); the driver runs against any
+frontend (local handler or gRPC stub) and asserts the outcome.
 """
 
 from __future__ import annotations
@@ -13,7 +16,9 @@ import uuid
 from typing import Callable, Dict
 
 from cadence_tpu.core.enums import EventType
+from cadence_tpu.core.events import RetryPolicy
 from cadence_tpu.runtime.api import SignalRequest, StartWorkflowRequest
+from cadence_tpu.worker.sdk import WorkflowCancelled
 
 TASK_LIST = "canary-tl"
 
@@ -66,6 +71,53 @@ def query_workflow(ctx, input):
     return b"ok"
 
 
+def sleeper_workflow(ctx, input):
+    # blocks forever: the timeout probe closes it via workflow timeout
+    yield ctx.wait_signal("never")
+    return b"unreachable"
+
+
+def cancellation_workflow(ctx, input):
+    # reference canary/cancellation.go: await ctx.Done, return its error
+    cause = yield ctx.wait_cancel()
+    raise WorkflowCancelled(cause or b"canceled")
+
+
+def canceller_workflow(ctx, input):
+    # reference canary/cancellation.go external variant
+    yield ctx.request_cancel_external("", input.decode())
+    return b"cancel-sent"
+
+
+def signaller_workflow(ctx, input):
+    yield ctx.signal_external("", input.decode(), "canary-signal", b"ext")
+    return b"signal-sent"
+
+
+def local_activity_workflow(ctx, input):
+    # result comes back through a MarkerRecorded event, never matching
+    out = yield ctx.local_activity("echo_local", input)
+    return b"local:" + out
+
+
+def search_attr_workflow(ctx, input):
+    yield ctx.upsert_search_attributes(
+        {"CustomKeywordField": input.decode()}
+    )
+    return b"upserted"
+
+
+def fail_once_workflow(ctx, input):
+    # whole-RUN failure on the first attempt; the engine's workflow
+    # retry policy starts attempt 2, which succeeds
+    out = yield ctx.schedule_activity("fail_once_activity", input)
+    return out
+
+
+def cron_tick_workflow(ctx, input):
+    return b"tick"
+
+
 _flaky_counters: Dict[str, int] = {}
 
 
@@ -81,7 +133,19 @@ def make_activities():
             raise RuntimeError(f"flaking (attempt {n})")
         return b"succeeded"
 
-    return {"echo_activity": echo_activity, "flaky_activity": flaky_activity}
+    def fail_once_activity(data: bytes) -> bytes:
+        key = "wf-retry:" + (data.decode() or "default")
+        n = _flaky_counters.get(key, 0) + 1
+        _flaky_counters[key] = n
+        if n < 2:
+            raise RuntimeError(f"failing the whole run (attempt {n})")
+        return b"retried"
+
+    return {
+        "echo_activity": echo_activity,
+        "flaky_activity": flaky_activity,
+        "fail_once_activity": fail_once_activity,
+    }
 
 
 WORKFLOWS: Dict[str, Callable] = {
@@ -91,6 +155,18 @@ WORKFLOWS: Dict[str, Callable] = {
     "canary-retry": retry_workflow,
     "canary-concurrent": concurrent_workflow,
     "canary-query": query_workflow,
+    "canary-sleeper": sleeper_workflow,
+    "canary-cancellation": cancellation_workflow,
+    "canary-canceller": canceller_workflow,
+    "canary-signaller": signaller_workflow,
+    "canary-local-activity": local_activity_workflow,
+    "canary-search-attr": search_attr_workflow,
+    "canary-fail-once": fail_once_workflow,
+    "canary-cron-tick": cron_tick_workflow,
+}
+
+LOCAL_ACTIVITIES: Dict[str, Callable] = {
+    "echo_local": lambda data: b"<" + data + b">",
 }
 
 
@@ -115,14 +191,29 @@ def _wait_result(fe, domain, wf_id, run_id, timeout_s=20.0) -> bytes:
     raise TimeoutError(f"{wf_id} still running after {timeout_s}s")
 
 
-def _start(fe, domain, wf_type, wf_id, input=b"", timeout=120):
+def _start(fe, domain, wf_type, wf_id, input=b"", timeout=120, **kw):
     return fe.start_workflow_execution(
         StartWorkflowRequest(
             domain=domain, workflow_id=wf_id, workflow_type=wf_type,
             task_list=TASK_LIST, input=input,
             execution_start_to_close_timeout_seconds=timeout,
+            **kw,
         )
     )
+
+
+def _wait_close(fe, domain, wf_id, run_id, timeout_s=20.0):
+    """Wait for the run to close; returns its final history event."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        desc = fe.describe_workflow_execution(domain, wf_id, run_id)
+        if not desc.is_running:
+            events, _ = fe.get_workflow_execution_history(
+                domain, wf_id, run_id
+            )
+            return events[-1]
+        time.sleep(0.05)
+    raise TimeoutError(f"{wf_id} still running after {timeout_s}s")
 
 
 def probe_echo(fe, domain) -> None:
@@ -205,6 +296,135 @@ def probe_reset(fe, domain) -> None:
     assert _wait_result(fe, domain, wf, new_run) == b"r"
 
 
+def probe_timeout(fe, domain) -> None:
+    # reference canary/timeout.go: a run that must close as TimedOut
+    wf = f"canary-timeout-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-sleeper", wf, timeout=1)
+    last = _wait_close(fe, domain, wf, run, timeout_s=20.0)
+    assert last.event_type == EventType.WorkflowExecutionTimedOut, (
+        last.event_type
+    )
+
+
+def probe_cancellation(fe, domain) -> None:
+    wf = f"canary-cancel-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-cancellation", wf)
+    fe.request_cancel_workflow_execution(domain, wf, run,
+                                         identity="canary")
+    last = _wait_close(fe, domain, wf, run)
+    assert last.event_type == EventType.WorkflowExecutionCanceled, (
+        last.event_type
+    )
+
+
+def probe_cancellation_external(fe, domain) -> None:
+    key = uuid.uuid4().hex[:8]
+    victim = f"canary-cancel-victim-{key}"
+    victim_run = _start(fe, domain, "canary-cancellation", victim)
+    canceller = f"canary-canceller-{key}"
+    run = _start(fe, domain, "canary-canceller", canceller,
+                 victim.encode())
+    assert _wait_result(fe, domain, canceller, run) == b"cancel-sent"
+    last = _wait_close(fe, domain, victim, victim_run)
+    assert last.event_type == EventType.WorkflowExecutionCanceled, (
+        last.event_type
+    )
+
+
+def probe_signal_external(fe, domain) -> None:
+    key = uuid.uuid4().hex[:8]
+    receiver = f"canary-sig-receiver-{key}"
+    receiver_run = _start(fe, domain, "canary-signal", receiver)
+    sender = f"canary-signaller-{key}"
+    run = _start(fe, domain, "canary-signaller", sender, receiver.encode())
+    assert _wait_result(fe, domain, sender, run) == b"signal-sent"
+    assert _wait_result(fe, domain, receiver, receiver_run) == (
+        b"signaled:ext"
+    )
+
+
+def probe_local_activity(fe, domain) -> None:
+    wf = f"canary-local-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-local-activity", wf, b"la")
+    assert _wait_result(fe, domain, wf, run) == b"local:<la>"
+    events, _ = fe.get_workflow_execution_history(domain, wf, run)
+    kinds = {e.event_type for e in events}
+    assert EventType.MarkerRecorded in kinds, "no marker recorded"
+    assert EventType.ActivityTaskScheduled not in kinds, (
+        "local activity went through matching"
+    )
+
+
+def probe_search_attributes(fe, domain) -> None:
+    key = f"canary-{uuid.uuid4().hex[:8]}"
+    wf = f"canary-sa-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-search-attr", wf, key.encode())
+    assert _wait_result(fe, domain, wf, run) == b"upserted"
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if fe.count_workflow_executions(
+            domain, f"CustomKeywordField = '{key}'"
+        ) >= 1:
+            return
+        time.sleep(0.1)
+    raise AssertionError("upserted search attribute never became queryable")
+
+
+def probe_workflow_retry(fe, domain) -> None:
+    # run 1 fails; the workflow-level retry policy restarts it
+    key = uuid.uuid4().hex[:8]
+    wf = f"canary-wfretry-{key}"
+    run = _start(
+        fe, domain, "canary-fail-once", wf, key.encode(),
+        retry_policy=RetryPolicy(
+            initial_interval_seconds=1, backoff_coefficient=1.0,
+            maximum_attempts=3, expiration_interval_seconds=0,
+        ),
+    )
+    first = _wait_close(fe, domain, wf, run)
+    assert first.event_type == EventType.WorkflowExecutionContinuedAsNew, (
+        first.event_type
+    )
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        desc = fe.describe_workflow_execution(domain, wf)
+        if desc.run_id != run and not desc.is_running:
+            assert _wait_result(fe, domain, wf, desc.run_id) == b"retried"
+            return
+        time.sleep(0.1)
+    raise TimeoutError("retry attempt never completed")
+
+
+def probe_cron(fe, domain) -> None:
+    # reference canary/cron.go: the schedule keeps producing runs
+    wf = f"canary-cron-{uuid.uuid4().hex[:8]}"
+    run = _start(fe, domain, "canary-cron-tick", wf,
+                 cron_schedule="@every 1s")
+    try:
+        first = _wait_close(fe, domain, wf, run)
+        assert first.event_type == (
+            EventType.WorkflowExecutionContinuedAsNew
+        ), first.event_type
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            n = fe.count_workflow_executions(
+                domain,
+                f"WorkflowID = '{wf}' AND "
+                "CloseStatus = 'CONTINUED_AS_NEW'",
+            )
+            if n >= 2:
+                return
+            time.sleep(0.1)
+        raise AssertionError("cron chain produced fewer than 2 fires")
+    finally:
+        try:
+            fe.terminate_workflow_execution(
+                domain, wf, reason="canary cron stop"
+            )
+        except Exception:
+            pass  # the chain may be between runs
+
+
 PROBES: Dict[str, Callable] = {
     "echo": probe_echo,
     "signal": probe_signal,
@@ -214,4 +434,12 @@ PROBES: Dict[str, Callable] = {
     "query": probe_query,
     "visibility": probe_visibility,
     "reset": probe_reset,
+    "timeout": probe_timeout,
+    "cancellation": probe_cancellation,
+    "cancellation_external": probe_cancellation_external,
+    "signal_external": probe_signal_external,
+    "local_activity": probe_local_activity,
+    "search_attributes": probe_search_attributes,
+    "workflow_retry": probe_workflow_retry,
+    "cron": probe_cron,
 }

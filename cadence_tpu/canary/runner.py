@@ -14,7 +14,13 @@ from typing import List, Optional
 from cadence_tpu.frontend.domain_handler import DomainAlreadyExistsError
 from cadence_tpu.worker import Worker
 
-from .probes import PROBES, TASK_LIST, WORKFLOWS, make_activities
+from .probes import (
+    LOCAL_ACTIVITIES,
+    PROBES,
+    TASK_LIST,
+    WORKFLOWS,
+    make_activities,
+)
 
 CANARY_DOMAIN = "cadence-canary"
 
@@ -47,6 +53,8 @@ def run_canary(
             worker.register_workflow(wf_type, fn)
         for name, fn in make_activities().items():
             worker.register_activity(name, fn)
+        for name, fn in LOCAL_ACTIVITIES.items():
+            worker.register_local_activity(name, fn)
         worker.register_query_handler(
             "canary-query", lambda qt, args: b"canary-query-alive"
         )

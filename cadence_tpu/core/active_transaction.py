@@ -637,6 +637,7 @@ class ActiveTransaction:
     def add_workflow_execution_cancel_requested(
         self, cause: str, identity: str, now: int,
         external_workflow_id: str = "", external_run_id: str = "",
+        request_id: str = "",
     ) -> HistoryEvent:
         self._require_running()
         if self.ms.execution_info.cancel_requested:
@@ -644,6 +645,7 @@ class ActiveTransaction:
         event = F.workflow_execution_cancel_requested(
             self._next_id(), self.version, now,
             cause=cause, identity=identity,
+            cancel_request_id=request_id,
             external_workflow_id=external_workflow_id,
             external_run_id=external_run_id,
         )

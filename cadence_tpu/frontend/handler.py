@@ -134,7 +134,19 @@ class WorkflowHandler:
         self._check_id(request.workflow_type, "workflowType")
         self._check_id(request.task_list, "taskList")
         self._check_blob(request.input, "input")
+        self._check_cron(request.cron_schedule)
         return self.history.start_workflow_execution(request)
+
+    @staticmethod
+    def _check_cron(cron_schedule: str) -> None:
+        if not cron_schedule:
+            return
+        from cadence_tpu.utils.cron import validate_cron_schedule
+
+        try:
+            validate_cron_schedule(cron_schedule)
+        except ValueError as e:
+            raise BadRequestError(str(e))
 
     def signal_workflow_execution(
         self, request: SignalRequest, **headers
@@ -152,6 +164,7 @@ class WorkflowHandler:
         self._check_id(request.start.workflow_id, "workflowId")
         self._check_id(request.signal_name, "signalName")
         self._check_blob(request.signal_input, "signal input")
+        self._check_cron(request.start.cron_schedule)
         return self.history.signal_with_start_workflow_execution(request)
 
     def terminate_workflow_execution(

@@ -57,11 +57,15 @@ class DecisionTaskHandler:
         now: int,
         identity: str = "",
         had_buffered_events: bool = False,
+        started_event_fn=None,
     ) -> None:
         self.txn = txn
         self.completed_id = completed_event_id
         self.now = now
         self.identity = identity
+        # lazily fetches the run's WorkflowExecutionStarted event (via
+        # the shard events cache) — cron/retry restarts need its input
+        self.started_event_fn = started_event_fn
         # captured BEFORE the completion event flushed the buffer — the
         # reference computes hasUnhandledEvents before applying decisions
         self.had_buffered_events = had_buffered_events
@@ -199,6 +203,8 @@ class DecisionTaskHandler:
     def _complete_workflow(self, a: dict) -> None:
         if not self._close_allowed():
             return
+        if self._restart_after_close("complete"):
+            return
         self.txn.add_workflow_execution_completed(
             self.completed_id, self.now, result=a.get("result", b"")
         )
@@ -207,11 +213,29 @@ class DecisionTaskHandler:
     def _fail_workflow(self, a: dict) -> None:
         if not self._close_allowed():
             return
+        if self._restart_after_close("fail", a.get("reason", "")):
+            return
         self.txn.add_workflow_execution_failed(
             self.completed_id, self.now,
             reason=a.get("reason", ""), details=a.get("details", b""),
         )
         self.workflow_closed = True
+
+    def _restart_after_close(self, close: str, reason: str = "") -> bool:
+        """Cron/retry continue-as-new instead of closing (reference
+        workflowExecutionContext retryWorkflow/cronWorkflow)."""
+        from .cron_retry import try_continue_after_close
+
+        try:
+            restarted = try_continue_after_close(
+                self.txn, self.txn.ms, self.started_event_fn, close,
+                self.now, error_reason=reason,
+            )
+        except WorkflowStateError as e:
+            raise DecisionFailure(_CAUSE_BAD_CONTINUE_AS_NEW, str(e))
+        if restarted:
+            self.workflow_closed = True
+        return restarted
 
     def _cancel_workflow(self, a: dict) -> None:
         if not self._close_allowed():

@@ -25,7 +25,12 @@ from cadence_tpu.core.enums import (
     WorkflowState,
 )
 from cadence_tpu.core.events import HistoryEvent
-from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION, TRANSIENT_EVENT_ID
+from cadence_tpu.core.ids import (
+    EMPTY_EVENT_ID,
+    EMPTY_VERSION,
+    FIRST_EVENT_ID,
+    TRANSIENT_EVENT_ID,
+)
 from cadence_tpu.core.mutable_state import MutableState
 from cadence_tpu.core.version_history import VersionHistories
 from cadence_tpu.utils.log import get_logger
@@ -214,6 +219,16 @@ class HistoryEngine:
             input=request.input,
             identity=request.identity,
             retry_policy=request.retry_policy,
+            # absolute retry budget: expiration_interval_seconds counts
+            # from the first run's start (reference historyEngine
+            # startWorkflow: ExpirationTime = now + ExpirationInterval)
+            expiration_timestamp=(
+                now + request.retry_policy.expiration_interval_seconds
+                * 1_000_000_000
+                if request.retry_policy
+                and request.retry_policy.expiration_interval_seconds
+                else 0
+            ),
             cron_schedule=request.cron_schedule,
             memo=request.memo,
             search_attributes=request.search_attributes,
@@ -371,7 +386,7 @@ class HistoryEngine:
 
     def request_cancel_workflow_execution(
         self, domain_name: str, workflow_id: str, run_id: str = "",
-        cause: str = "", identity: str = "",
+        cause: str = "", identity: str = "", request_id: str = "",
     ) -> None:
         domain = self.domains.get_by_name(domain_name)
         version = self._domain_version(domain)
@@ -380,12 +395,22 @@ class HistoryEngine:
             txn = self._txn(ctx, ms, version)
             try:
                 txn.add_workflow_execution_cancel_requested(
-                    cause, identity, self.shard.now()
+                    cause, identity, self.shard.now(),
+                    request_id=request_id,
                 )
                 if not ms.has_pending_decision():
                     txn.add_decision_task_scheduled(self.shard.now())
             except WorkflowStateError as e:
                 if ms.execution_info.cancel_requested:
+                    # same requester retrying is idempotent success
+                    # (reference historyEngine RequestCancel dedup by
+                    # requestID)
+                    if (
+                        request_id
+                        and ms.execution_info.cancel_request_id
+                        == request_id
+                    ):
+                        return
                     raise CancellationAlreadyRequestedError(str(e))
                 raise EntityNotExistsServiceError(str(e))
             result = txn.close()
@@ -502,6 +527,7 @@ class HistoryEngine:
             handler = DecisionTaskHandler(
                 txn, completed.event_id, now, identity=identity,
                 had_buffered_events=had_buffered,
+                started_event_fn=lambda: ctx.get_event(ms, FIRST_EVENT_ID),
             )
             try:
                 handler.handle(decisions)

@@ -313,18 +313,40 @@ class TimerQueueProcessor:
         self._mutate(task, action)
 
     def _process_workflow_timeout(self, task: TimerTask) -> None:
-        # processWorkflowTimeout (:687): verify the run really expired
-        def action(txn, ms, now):
+        # processWorkflowTimeout (:687): verify the run really expired;
+        # a run with retry budget or a cron schedule restarts instead of
+        # closing (reference retryWorkflow/cronWorkflow on timeout)
+        from cadence_tpu.core.ids import FIRST_EVENT_ID
+        from cadence_tpu.runtime.engine.cron_retry import (
+            try_continue_after_close,
+        )
+
+        def run(ctx, ms):
+            if not ms.is_workflow_execution_running():
+                return
             ei = ms.execution_info
             if ei.workflow_timeout <= 0:
-                return False
+                return
+            now = self.shard.now()
             expiry = ei.start_timestamp + ei.workflow_timeout * 1_000_000_000
             if expiry > now:
-                return False
-            txn.add_workflow_execution_timed_out(now)
-            return True
+                return
+            txn = self.engine._txn(ctx, ms, ms.current_version)
+            try:
+                if not try_continue_after_close(
+                    txn, ms, lambda: ctx.get_event(ms, FIRST_EVENT_ID),
+                    "timeout", now, error_reason=_TIMEOUT_REASON,
+                ):
+                    txn.add_workflow_execution_timed_out(now)
+            except WorkflowStateError as e:
+                raise EntityNotExistsServiceError(str(e))
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self.engine._notify(result)
 
-        self._mutate(task, action)
+        self.engine.with_workflow(
+            task.domain_id, task.workflow_id, task.run_id, run
+        )
 
     def _process_activity_retry(self, task: TimerTask) -> None:
         # processActivityRetryTimer (:610): push the next attempt

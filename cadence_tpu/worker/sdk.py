@@ -42,6 +42,21 @@ class ActivityError(Exception):
         self.details = details
 
 
+class WorkflowCancelled(Exception):
+    """Raise from workflow code to close the run as Canceled.
+
+    The reference SDK's equivalent is returning ctx.Err() after
+    ctx.Done() fires (reference canary/cancellation.go); here the
+    workflow observes the cancel request via ``ctx.wait_cancel()`` /
+    ``ctx.cancel_requested()`` and raises this to emit a
+    CancelWorkflowExecution decision.
+    """
+
+    def __init__(self, details: bytes = b"") -> None:
+        super().__init__(details)
+        self.details = details
+
+
 class _NonDeterminismError(Exception):
     pass
 
@@ -108,6 +123,29 @@ class _SignalExternalCmd:
     input: bytes
 
 
+@dataclasses.dataclass
+class _CancelWaitCmd:
+    pass
+
+
+@dataclasses.dataclass
+class _CancelExternalCmd:
+    domain: str
+    workflow_id: str
+    run_id: str
+
+
+@dataclasses.dataclass
+class _UpsertSearchAttrsCmd:
+    attrs: dict
+
+
+@dataclasses.dataclass
+class _LocalActivityCmd:
+    activity_type: str
+    input: bytes
+
+
 class WorkflowContext:
     """Command factory handed to workflow code."""
 
@@ -158,6 +196,31 @@ class WorkflowContext:
             domain, workflow_id, run_id, signal_name, input
         )
 
+    def wait_cancel(self) -> _CancelWaitCmd:
+        """Block until this run's cancellation is requested; resumes with
+        the request's cause (reference ctx.Done)."""
+        return _CancelWaitCmd()
+
+    def request_cancel_external(
+        self, domain: str, workflow_id: str, run_id: str = "",
+    ) -> _CancelExternalCmd:
+        """Request cancellation of another workflow (fire-and-forget,
+        reference RequestCancelExternalWorkflowExecution decision)."""
+        return _CancelExternalCmd(domain, workflow_id, run_id)
+
+    def upsert_search_attributes(self, attrs: dict) -> _UpsertSearchAttrsCmd:
+        """Attach/overwrite advanced-visibility search attributes."""
+        return _UpsertSearchAttrsCmd(attrs)
+
+    def local_activity(
+        self, activity_type: str, input: bytes = b"",
+    ) -> _LocalActivityCmd:
+        """Run an activity inline in the decision task; its result is
+        recorded as a MarkerRecorded event, so replay never re-executes
+        it (reference local activity semantics: no ActivityTaskScheduled
+        round-trip through matching)."""
+        return _LocalActivityCmd(activity_type, input)
+
 
 # -- history → replay state -----------------------------------------------
 
@@ -184,6 +247,13 @@ class _ReplayState:
         # same target is not deduped away
         self.signals_external_list: List[tuple] = []
         self.children_list: List[str] = []
+        self.cancels_external_list: List[str] = []
+        # cancel request on THIS run
+        self.cancel_requested = False
+        self.cancel_cause: bytes = b""
+        # markers in record order (local-activity results replay from here)
+        self.markers: List[Tuple[str, bytes]] = []
+        self.upsert_count = 0
 
         sched_to_aid: Dict[int, str] = {}
         init_to_child: Dict[int, str] = {}
@@ -257,6 +327,22 @@ class _ReplayState:
                 self.signals_external_list.append(
                     (a.get("workflow_id", ""), a.get("signal_name", ""))
                 )
+            elif et == (
+                EventType.RequestCancelExternalWorkflowExecutionInitiated
+            ):
+                self.cancels_external_list.append(a.get("workflow_id", ""))
+            elif et == EventType.WorkflowExecutionCancelRequested:
+                self.cancel_requested = True
+                cause = a.get("cause", "") or ""
+                self.cancel_cause = (
+                    cause.encode() if isinstance(cause, str) else cause
+                )
+            elif et == EventType.MarkerRecorded:
+                self.markers.append(
+                    (a.get("marker_name", ""), a.get("details", b"") or b"")
+                )
+            elif et == EventType.UpsertWorkflowSearchAttributes:
+                self.upsert_count += 1
 
 
 # -- the replay runner ----------------------------------------------------
@@ -265,13 +351,16 @@ class _ReplayState:
 class _Driver:
     def __init__(
         self, fn: Callable, state: _ReplayState,
+        local_executor: Optional[Callable] = None,
     ) -> None:
         self.fn = fn
         self.state = state
         self.decisions: List[Decision] = []
-        self.seq = {"a": 0, "t": 0, "c": 0, "s": 0}
+        self.seq = {"a": 0, "t": 0, "c": 0, "s": 0, "rc": 0, "m": 0}
         self.signal_cursor: Dict[str, int] = {}
         self.closed = False
+        # executes local activities inline (activity_type, input) -> bytes
+        self.local_executor = local_executor
 
     def _next_id(self, kind: str) -> str:
         self.seq[kind] += 1
@@ -312,6 +401,15 @@ class _Driver:
             return self.decisions
         except _NonDeterminismError:
             raise
+        except WorkflowCancelled as wc:
+            if not self.closed:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.CancelWorkflowExecution,
+                        {"details": wc.details},
+                    )
+                )
+            return self.decisions
         except Exception:
             if not self.closed:
                 self.decisions.append(
@@ -427,6 +525,55 @@ class _Driver:
                     )
                 )
             return None, None, False  # fire and forget
+        if isinstance(cmd, _CancelWaitCmd):
+            if st.cancel_requested:
+                return st.cancel_cause, None, False
+            return None, None, True  # wait for the cancel request
+        if isinstance(cmd, _CancelExternalCmd):
+            rc_idx = self.seq["rc"]
+            self.seq["rc"] += 1
+            if rc_idx >= len(st.cancels_external_list):
+                self.decisions.append(
+                    Decision(
+                        DecisionType.RequestCancelExternalWorkflowExecution,
+                        {
+                            "domain": cmd.domain,
+                            "workflow_id": cmd.workflow_id,
+                            "run_id": cmd.run_id,
+                        },
+                    )
+                )
+            return None, None, False  # fire and forget
+        if isinstance(cmd, _UpsertSearchAttrsCmd):
+            if self.seq.setdefault("u", 0) >= st.upsert_count:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.UpsertWorkflowSearchAttributes,
+                        {"search_attributes": dict(cmd.attrs)},
+                    )
+                )
+            self.seq["u"] += 1
+            return None, None, False
+        if isinstance(cmd, _LocalActivityCmd):
+            m_idx = self.seq["m"]
+            self.seq["m"] += 1
+            if m_idx < len(st.markers):
+                return st.markers[m_idx][1], None, False
+            if self.local_executor is None:
+                raise _NonDeterminismError(
+                    "local activity yielded but no executor is wired "
+                    "(replay_decide without a DecisionWorker)"
+                )
+            result = self.local_executor(cmd.activity_type, cmd.input)
+            result = result if isinstance(result, bytes) else b""
+            self.decisions.append(
+                Decision(
+                    DecisionType.RecordMarker,
+                    {"marker_name": f"local_activity:{cmd.activity_type}",
+                     "details": result},
+                )
+            )
+            return result, None, False
         if isinstance(cmd, _ContinueAsNewCmd):
             self.decisions.append(
                 Decision(
@@ -456,6 +603,22 @@ class WorkflowRegistry:
     def __init__(self) -> None:
         self._workflows: Dict[str, Callable] = {}
         self._query_handlers: Dict[str, Callable] = {}
+        self._local_activities: Dict[str, Callable] = {}
+
+    def register_local_activity(
+        self, activity_type: str, fn: Callable
+    ) -> None:
+        """Local activities run inline in the decision task (not via
+        matching), so they register with the workflow side."""
+        self._local_activities[activity_type] = fn
+
+    def local_activity(self, activity_type: str) -> Callable:
+        fn = self._local_activities.get(activity_type)
+        if fn is None:
+            raise KeyError(
+                f"local activity {activity_type!r} not registered"
+            )
+        return fn
 
     def register_workflow(self, workflow_type: str, fn: Callable) -> None:
         self._workflows[workflow_type] = fn
@@ -483,7 +646,11 @@ def replay_decide(
     if state is None:
         state = _ReplayState(history)
     fn = registry.workflow(state.workflow_type)
-    return _Driver(fn, state).run()
+
+    def local_executor(activity_type: str, input: bytes) -> bytes:
+        return registry.local_activity(activity_type)(input)
+
+    return _Driver(fn, state, local_executor=local_executor).run()
 
 
 class DecisionWorker:
@@ -676,6 +843,9 @@ class Worker:
 
     def register_query_handler(self, workflow_type: str, fn) -> None:
         self.registry.register_query_handler(workflow_type, fn)
+
+    def register_local_activity(self, activity_type: str, fn) -> None:
+        self.registry.register_local_activity(activity_type, fn)
 
     def start(self) -> None:
         self.decisions.start()

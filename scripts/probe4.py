@@ -22,6 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# persistent compile cache: kernel compiles at T=1024 run minutes; cache
+# them across probe/bench invocations
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 
 def checksum(state) -> float:
     """Scalar that depends on every state leaf (forces full execution)."""
@@ -57,6 +65,9 @@ def main():
     ap.add_argument("--pallas", action="store_true")
     ap.add_argument("--teb", action="store_true")
     ap.add_argument("--host-presence", action="store_true")
+    ap.add_argument("--ablate", default="0",
+                    help="comma list of kernel ablation levels for --teb "
+                         "(0=full FSM .. 5=empty body)")
     args = ap.parse_args()
 
     from cadence_tpu.ops import schema as S
@@ -127,17 +138,19 @@ def main():
                 # rows_concat excludes padding rows
                 rows_cat = events[valid]
                 pres = jnp.asarray(presence_masks(rows_cat, lens, T, args.bt))
-            f = jax.jit(lambda s, e: replay_scan_pallas_teb(
-                s, e, caps, tb=args.tb, interpret=False, bt=args.bt,
-                presence=pres))
-            try:
-                dt, v = timeit(f, state0, ev_teb, args.iters)
-                print(f"  B={batch:6d} teb    {dt*1e3:9.2f} ms  "
-                      f"{dt/T*1e6:8.2f} us/step  {batch/dt:12.0f} hist/s  "
-                      f"{batch*T/dt/1e6:8.1f} Mev/s  cs={v}")
-            except Exception as exc:
-                print(f"  B={batch:6d} teb FAILED: {type(exc).__name__}: "
-                      f"{str(exc)[:300]}")
+            for ab in [int(a) for a in args.ablate.split(",")]:
+                f = jax.jit(lambda s, e, ab=ab: replay_scan_pallas_teb(
+                    s, e, caps, tb=args.tb, interpret=False, bt=args.bt,
+                    presence=pres, ablate=ab))
+                try:
+                    dt, v = timeit(f, state0, ev_teb, args.iters)
+                    print(f"  B={batch:6d} teb a{ab} {dt*1e3:9.2f} ms  "
+                          f"{dt/T*1e6:8.2f} us/step  {batch/dt:12.0f} hist/s  "
+                          f"{batch*T/dt/1e6:8.1f} Mev/s  cs={v}", flush=True)
+                except Exception as exc:
+                    print(f"  B={batch:6d} teb a{ab} FAILED: "
+                          f"{type(exc).__name__}: {str(exc)[:300]}",
+                          flush=True)
 
         if args.pallas:
             f = jax.jit(lambda s, e: replay_scan_pallas(

@@ -11,5 +11,7 @@ def test_all_probes_pass():
     assert not failures, failures
     assert {r["probe"] for r in results} == {
         "echo", "signal", "timer", "retry", "concurrent", "query",
-        "visibility", "reset",
+        "visibility", "reset", "timeout", "cancellation",
+        "cancellation_external", "signal_external", "local_activity",
+        "search_attributes", "workflow_retry", "cron",
     }

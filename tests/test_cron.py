@@ -1,0 +1,60 @@
+"""Cron spec parsing (utils/cron.py) — the reference validates
+cronSchedule with robfig/cron (common/util.go ValidateCronSchedule);
+these pin the same 5-field + @every surface."""
+
+import pytest
+
+from cadence_tpu.utils.cron import (
+    CronSchedule,
+    next_cron_delay_seconds,
+    validate_cron_schedule,
+)
+
+# 2025-07-30 04:00:00 UTC, a Wednesday
+WED_4AM = 1753848000
+
+
+def test_every_seconds():
+    assert CronSchedule("@every 5s").next_delay_seconds(WED_4AM) == 5
+    assert CronSchedule("@every 2m").next_delay_seconds(WED_4AM) == 120
+    assert CronSchedule("@every 1h").next_delay_seconds(WED_4AM) == 3600
+
+
+def test_five_field_basics():
+    # every 5 minutes, on the boundary: next fire is 04:05
+    assert CronSchedule("*/5 * * * *").next_delay_seconds(WED_4AM) == 300
+    # weekdays at 09:00: same day 9am
+    assert CronSchedule("0 9 * * 1-5").next_delay_seconds(WED_4AM) == 5 * 3600
+    # daily at midnight: next day
+    assert CronSchedule("0 0 * * *").next_delay_seconds(WED_4AM) == 20 * 3600
+
+
+def test_dow_dom_or_rule():
+    # both dom and dow restricted: either matches (standard cron)
+    s = CronSchedule("0 0 31 * 0")  # 31st OR Sunday
+    # from Wed Jul 30 04:00, the 31st (Thu 00:00) beats next Sunday
+    assert s.next_delay_seconds(WED_4AM) == 20 * 3600
+    # with dom unrestricted, only Sunday matches: Sun Aug 3 00:00
+    s2 = CronSchedule("0 0 * * 0")
+    assert s2.next_delay_seconds(WED_4AM) == 20 * 3600 + 3 * 24 * 3600
+
+
+def test_minute_offset_not_boundary():
+    # 04:00:30 → */5 fires at 04:05:00
+    assert CronSchedule("*/5 * * * *").next_delay_seconds(WED_4AM + 30) == 270
+
+
+def test_validation():
+    validate_cron_schedule("")  # empty ok (no cron)
+    validate_cron_schedule("* * * * *")
+    for bad in ("61 * * * *", "* 24 * * *", "* * 0 * *", "* * * 13 *",
+                "* * * * 7", "* * * *", "nonsense", "@every 0s",
+                "*/0 * * * *", "1, * * * *", ",2 * * * *"):
+        with pytest.raises(ValueError):
+            validate_cron_schedule(bad)
+
+
+def test_next_delay_helper_swallows_bad_specs():
+    assert next_cron_delay_seconds("", WED_4AM) == 0
+    assert next_cron_delay_seconds("garbage", WED_4AM) == 0
+    assert next_cron_delay_seconds("@every 3s", WED_4AM) == 3

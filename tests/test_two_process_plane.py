@@ -37,7 +37,10 @@ from cadence_tpu.runtime.service import HistoryService
 from cadence_tpu.rpc.server import HistoryRPCServer, MatchingRPCServer
 from cadence_tpu.utils.hashing import shard_for_workflow
 
-NUM_SHARDS = 4
+# 16 shards, not 4: the ring is seeded with real (random-port) host
+# identities, and with only 4 shard keys there's a ~6% chance one host
+# owns every shard, which starves the cross-process assertion below.
+NUM_SHARDS = 16
 
 CHILD_SCRIPT = r"""
 import sys, time
