@@ -32,12 +32,15 @@ class RunningServer:
     history_client: object = None
     matching_client: object = None
     rpc_servers: Dict[str, object] = dataclasses.field(default_factory=dict)
+    pprof: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
         return {name: s.address for name, s in self.rpc_servers.items()}
 
     def stop(self) -> None:
+        if self.pprof is not None:
+            self.pprof.stop()
         for s in self.rpc_servers.values():
             s.stop()
         if self.worker is not None:
@@ -118,6 +121,15 @@ def start_services(
         config=cfg, services=services, persistence=persistence,
         domains=domains, monitor=monitor,
     )
+    # one diagnostics endpoint per process (common/pprof.go Start):
+    # first configured service's port wins
+    for s in services:
+        sc = cfg.services.get(s)
+        if sc is not None and sc.pprof_port:
+            from cadence_tpu.utils.pprof import PProfServer
+
+            out.pprof = PProfServer(port=sc.pprof_port).start()
+            break
     out.domain_handler = DomainHandler(
         persistence.metadata, cluster_metadata
     )

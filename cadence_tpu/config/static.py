@@ -50,6 +50,8 @@ class ServiceConfig:
     address doubles as the host's ring identity."""
 
     rpc_address: str = "127.0.0.1:0"
+    # ref config.go Service.PProf.Port: 0 = diagnostics endpoint off
+    pprof_port: int = 0
 
 
 @dataclasses.dataclass
@@ -167,6 +169,7 @@ def load_config_dict(raw: dict) -> ServerConfig:
         for name, sc in (services or {}).items():
             cfg.services[name] = ServiceConfig(**_take(sc or {}, {
                 "rpcAddress": "rpc_address",
+                "pprofPort": "pprof_port",
             }, f"services.{name}"))
 
     ring = raw.pop("ring", None)

@@ -99,6 +99,164 @@ class InternalTask:
             self._finish(error)
 
 
+class _AppendRequest:
+    """One producer's pending write, parked on the writer thread."""
+
+    __slots__ = ("info", "done", "error")
+
+    def __init__(self, info: TaskInfo) -> None:
+        self.info = info
+        self.done = threading.Event()
+        self.error: Optional[Exception] = None
+
+
+class TaskWriter:
+    """Batched backlog appends (reference taskWriter.go:appendTasks).
+
+    Producers park on a request queue; one writer thread drains up to
+    ``MAX_BATCH`` requests, allocates their task ids inside the leased
+    block, and persists them with ONE create_tasks call — under a task
+    storm the store sees O(storm/batch) writes instead of O(storm),
+    and the rangeID fencing condition is checked once per batch.
+    """
+
+    MAX_BATCH = 100
+
+    def __init__(self, mgr: "TaskListManager") -> None:
+        self._mgr = mgr
+        self._queue: List[_AppendRequest] = []
+        self._lock = threading.Lock()
+        self._signal = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._write_pump,
+            name=f"taskWriter-{mgr.id.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def append(self, info: TaskInfo) -> None:
+        """Park until the batch containing ``info`` is persisted."""
+        req = _AppendRequest(info)
+        with self._lock:
+            if self._stopped.is_set():
+                raise RuntimeError("task writer stopped")
+            self._queue.append(req)
+        self._signal.set()
+        req.done.wait(timeout=30.0)
+        if not req.done.is_set():
+            raise TimeoutError("task append timed out")
+        if req.error is not None:
+            raise req.error
+
+    def _write_pump(self) -> None:
+        mgr = self._mgr
+        while True:
+            self._signal.wait(timeout=0.1)
+            self._signal.clear()
+            if self._stopped.is_set() and not self._queue:
+                return
+            while True:
+                with self._lock:
+                    batch = self._queue[: self.MAX_BATCH]
+                    del self._queue[: len(batch)]
+                if not batch:
+                    break
+                try:
+                    self._persist(batch)
+                except Exception as e:  # surface to every parked producer
+                    for req in batch:
+                        req.error = e
+                finally:
+                    for req in batch:
+                        req.done.set()
+                mgr._backlog_signal.set()
+
+    def _persist(self, batch: List[_AppendRequest]) -> None:
+        mgr = self._mgr
+        now = mgr._time.now()
+        with mgr._write_lock:
+            for req in batch:
+                info = req.info
+                info.task_id = mgr._allocate_task_id()
+                if info.created_time == 0:
+                    info.created_time = now
+                if (
+                    info.schedule_to_start_timeout_seconds > 0
+                    and info.expiry_time == 0
+                ):
+                    info.expiry_time = info.created_time + int(
+                        info.schedule_to_start_timeout_seconds * 1e9
+                    )
+            infos = [r.info for r in batch]
+            try:
+                mgr._store.create_tasks(mgr._info, infos)
+            except ConditionFailedError:
+                # lost the lease (another owner); re-lease, re-id, retry
+                # once — the whole batch moves to the new block
+                mgr._release()
+                for req in batch:
+                    req.info.task_id = mgr._allocate_task_id()
+                mgr._store.create_tasks(mgr._info, infos)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._signal.set()
+        self._thread.join(timeout=5.0)
+        with self._lock:
+            drained = self._queue[:]
+            self._queue.clear()
+        for req in drained:
+            req.error = RuntimeError("task writer stopped")
+            req.done.set()
+
+
+class TaskGC:
+    """Throttled backlog GC (reference taskGC.go).
+
+    Completed tasks are only acked in memory; the store rows below the
+    ack level are range-deleted when enough completions accumulate or
+    the GC interval elapses — not on every completion, which would turn
+    each task into an extra store round-trip.
+    """
+
+    THRESHOLD = 100
+    INTERVAL_S = 1.0
+
+    def __init__(self, mgr: "TaskListManager") -> None:
+        self._mgr = mgr
+        self._since_gc = 0
+        self._last_gc = mgr._time.now()
+        self._last_deleted_level = mgr._ack.ack_level
+
+    def run_now(self, ack_level: int) -> None:
+        mgr = self._mgr
+        if ack_level > self._last_deleted_level:
+            # ack_level itself is completed; the store deletes < level
+            mgr._store.complete_tasks_less_than(
+                mgr.id.domain_id, mgr.id.name, mgr.id.task_type,
+                ack_level + 1,
+            )
+            self._last_deleted_level = ack_level
+        mgr._info.ack_level = ack_level
+        try:
+            mgr._store.update_task_list(mgr._info)
+        except ConditionFailedError:
+            pass  # lease moved; new owner persists its own ack level
+        self._since_gc = 0
+        self._last_gc = mgr._time.now()
+
+    def maybe_run(self, ack_level: int) -> None:
+        self._since_gc += 1
+        due = (
+            self._since_gc >= self.THRESHOLD
+            or self._mgr._time.now() - self._last_gc
+            >= self.INTERVAL_S * 1e9
+        )
+        if due:
+            self.run_now(ack_level)
+
+
 class TaskListManager:
     def __init__(
         self,
@@ -127,6 +285,8 @@ class TaskListManager:
         self._last_activity = self._time.now()
         self._max_sync_wait = max_sync_match_wait_s
         self.idle_ttl_s = idle_tasklist_ttl_s
+        self._writer = TaskWriter(self)
+        self._gc = TaskGC(self)
         self._reader = threading.Thread(
             target=self._read_pump, name=f"taskReader-{task_list_id.name}",
             daemon=True,
@@ -140,12 +300,16 @@ class TaskListManager:
             self.id.domain_id, self.id.name, self.id.task_type
         )
 
+    def _release(self) -> None:
+        # caller holds _write_lock: take a fresh lease + taskID block
+        self._info = self._lease()
+        self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
+        self._max_task_id = self._info.range_id * RANGE_SIZE
+
     def _allocate_task_id(self) -> int:
         # caller holds _write_lock
         if self._next_task_id > self._max_task_id:
-            self._info = self._lease()
-            self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
-            self._max_task_id = self._info.range_id * RANGE_SIZE
+            self._release()
         tid = self._next_task_id
         self._next_task_id += 1
         return tid
@@ -153,7 +317,8 @@ class TaskListManager:
     # -- producer -------------------------------------------------------
 
     def add_task(self, info: TaskInfo) -> bool:
-        """Sync-match if a poller waits and no backlog; else persist.
+        """Sync-match if a poller waits and no backlog; else persist via
+        the batched writer.
 
         Returns True when the task was sync-matched (never persisted).
         Reference taskListManager.AddTask: backlog present ⇒ skip sync
@@ -164,24 +329,7 @@ class TaskListManager:
             task = InternalTask(info, finish=None, sync=True)
             if self.matcher.offer(task, timeout=self._max_sync_wait):
                 return True
-        with self._write_lock:
-            info.task_id = self._allocate_task_id()
-            if info.created_time == 0:
-                info.created_time = self._time.now()
-            if info.schedule_to_start_timeout_seconds > 0 and info.expiry_time == 0:
-                info.expiry_time = info.created_time + int(
-                    info.schedule_to_start_timeout_seconds * 1e9
-                )
-            try:
-                self._store.create_tasks(self._info, [info])
-            except ConditionFailedError:
-                # lost the lease (another owner); re-lease and retry once
-                self._info = self._lease()
-                self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
-                self._max_task_id = self._info.range_id * RANGE_SIZE
-                info.task_id = self._allocate_task_id()
-                self._store.create_tasks(self._info, [info])
-        self._backlog_signal.set()
+        self._writer.append(info)
         return False
 
     # -- consumer -------------------------------------------------------
@@ -238,20 +386,11 @@ class TaskListManager:
         self._complete(task_id)
 
     def _complete(self, task_id: int) -> None:
+        # in-memory ack only; the throttled TaskGC range-deletes the
+        # store rows + persists the ack level (reference taskGC.go)
         self._ack.complete(task_id)
         ack = self._ack.update_ack_level()
-        self._store.complete_task(
-            self.id.domain_id, self.id.name, self.id.task_type, task_id
-        )
-        # taskGC: range-delete below ack level, persist ack level
-        self._store.complete_tasks_less_than(
-            self.id.domain_id, self.id.name, self.id.task_type, ack
-        )
-        self._info.ack_level = ack
-        try:
-            self._store.update_task_list(self._info)
-        except ConditionFailedError:
-            pass  # lease moved; new owner persists its own ack level
+        self._gc.maybe_run(ack)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -274,8 +413,7 @@ class TaskListManager:
     def stop(self) -> None:
         self._stopped.set()
         self._backlog_signal.set()
+        self._writer.stop()
         self.matcher.shutdown()
-        try:
-            self._store.update_task_list(self._info)
-        except ConditionFailedError:
-            pass
+        # final GC pass so a clean shutdown leaves no acked rows behind
+        self._gc.run_now(self._ack.update_ack_level())

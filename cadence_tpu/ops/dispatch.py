@@ -1,0 +1,220 @@
+"""Double-buffered host→device replay dispatch.
+
+SURVEY §2.8 maps the reference's intra-shard pipelining (worker pools
+draining queue tasks concurrently, replicationTaskProcessor.go's
+sequential batch pump) to a host→device pipeline: while the device
+replays batch k, the host packs batch k+1 (the C++ sidecar scatter,
+native/sidecar.cpp) and stages its event tensor for transfer. JAX's
+async dispatch makes a single extra thread sufficient: ``device_put``
+and the jitted replay call return immediately, so pack(k+1) runs on the
+CPU while replay(k) runs on the device, and the bounded stage queue
+(``depth``) provides the double-buffer backpressure.
+
+Used by the replication rebuild path for storm-sized request streams
+(runtime/replication/rebuilder.py rebuild_many) and usable standalone::
+
+    with DeviceDispatcher(caps) as d:
+        for i, batch in enumerate(batches):
+            d.submit(i, batch)
+        d.finish()
+        for batch_id, packed, final in d.results():
+            ...  # final is a device StateTensors, fetch/unpack at will
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from . import schema as S
+
+
+class DispatchError(Exception):
+    def __init__(self, batch_id, cause: BaseException) -> None:
+        super().__init__(f"batch {batch_id}: {cause!r}")
+        self.batch_id = batch_id
+        self.cause = cause
+
+
+class DeviceDispatcher:
+    """Pipelines pack (host, C++ sidecar) → H2D → replay (device).
+
+    depth bounds how many packed batches may be staged ahead of the
+    device — 2 is classic double buffering. Results come back in
+    submission order from :meth:`results`.
+    """
+
+    def __init__(
+        self,
+        caps: Optional[S.Capacities] = None,
+        depth: int = 2,
+        kernel: str = "auto",
+    ) -> None:
+        self.caps = caps or S.Capacities()
+        self._in: "queue.Queue" = queue.Queue()
+        self._staged: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._out: "queue.Queue" = queue.Queue()
+        self._kernel = kernel
+        self._packer = threading.Thread(
+            target=self._pack_pump, name="dispatch-pack", daemon=True
+        )
+        self._runner = threading.Thread(
+            target=self._run_pump, name="dispatch-run", daemon=True
+        )
+        self._started = False
+
+    # -- producer side --------------------------------------------------
+
+    def submit(self, batch_id, histories: Sequence[Tuple]) -> None:
+        """Enqueue one batch of (workflow_id, run_id, event_batches)."""
+        if not self._started:
+            self._packer.start()
+            self._runner.start()
+            self._started = True
+        self._in.put((batch_id, histories))
+
+    def finish(self) -> None:
+        """No more submits; results() ends after the queued work."""
+        self._in.put(None)
+
+    # -- pipeline stages -------------------------------------------------
+
+    def _pack_pump(self) -> None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from .pack import pack_histories
+        except Exception as e:
+            # no usable jax on this host: every queued batch fails fast
+            # (the rebuilder falls back per batch) instead of the pump
+            # dying silently and results() hanging forever
+            while True:
+                item = self._in.get()
+                if item is None:
+                    self._staged.put(None)
+                    return
+                self._staged.put(DispatchError(item[0], e))
+
+        use_pallas = self._use_pallas()
+        while True:
+            item = self._in.get()
+            if item is None:
+                self._staged.put(None)
+                return
+            batch_id, histories = item
+            try:
+                packed = pack_histories(histories, caps=self.caps)
+                if use_pallas:
+                    events = jax.device_put(jnp.asarray(packed.teb()))
+                else:
+                    events = jax.device_put(
+                        jnp.asarray(packed.time_major())
+                    )
+                state0 = jax.tree_util.tree_map(
+                    jnp.asarray,
+                    S.empty_state(packed.batch, self.caps),
+                )
+                # blocks when `depth` batches are already staged — the
+                # double-buffer backpressure
+                self._staged.put((batch_id, packed, events, state0))
+            except Exception as e:
+                self._staged.put(DispatchError(batch_id, e))
+
+    def _run_pump(self) -> None:
+        use_pallas = self._use_pallas()
+        while True:
+            item = self._staged.get()
+            if item is None:
+                self._out.put(None)
+                return
+            if isinstance(item, DispatchError):
+                self._out.put(item)
+                continue
+            batch_id, packed, events, state0 = item
+            try:
+                if use_pallas:
+                    from .replay_pallas import replay_scan_pallas_teb
+
+                    final = replay_scan_pallas_teb(
+                        state0, events, self.caps
+                    )
+                else:
+                    from .replay import replay_scan
+
+                    final = replay_scan(state0, events)
+                # async dispatch: the call returns while the device
+                # works; the next H2D/pack proceeds immediately
+                self._out.put((batch_id, packed, final))
+            except Exception as e:
+                self._out.put(DispatchError(batch_id, e))
+
+    def _use_pallas(self) -> bool:
+        if self._kernel == "auto":
+            try:
+                import jax
+
+                return jax.default_backend() == "tpu"
+            except Exception:
+                return False
+        return self._kernel == "pallas"
+
+    # -- consumer side ----------------------------------------------------
+
+    def results(self, strict: bool = True) -> Iterator[Tuple]:
+        """Yields (batch_id, packed, final_state) in submission order.
+
+        A failed batch raises its DispatchError when its turn comes
+        (strict, default) or is yielded as the DispatchError itself
+        (strict=False) so the caller can fall back per batch and keep
+        consuming.
+        """
+        while True:
+            item = self._out.get()
+            if item is None:
+                return
+            if isinstance(item, DispatchError):
+                if strict:
+                    raise item
+                yield item
+                continue
+            yield item
+
+    def __enter__(self) -> "DeviceDispatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._started:
+            self.finish()
+            # drain so the pumps exit even on abnormal exit
+            while True:
+                if self._out.get() is None:
+                    break
+
+
+def replay_stream(
+    histories: Sequence[Tuple],
+    caps: Optional[S.Capacities] = None,
+    batch_size: int = 4096,
+    depth: int = 2,
+    kernel: str = "auto",
+) -> List[Tuple]:
+    """Replay a large history stream through the pipelined dispatcher.
+
+    Splits ``histories`` into ``batch_size`` chunks and returns
+    [(packed, final_state), ...] in order — the storm-drain entry the
+    replication rebuilder uses.
+    """
+    out: List[Tuple] = []
+    d = DeviceDispatcher(caps=caps, depth=depth, kernel=kernel)
+    n = 0
+    for i in range(0, len(histories), batch_size):
+        d.submit(i, histories[i : i + batch_size])
+        n += 1
+    if n == 0:
+        return out
+    d.finish()
+    for _, packed, final in d.results():
+        out.append((packed, final))
+    return out

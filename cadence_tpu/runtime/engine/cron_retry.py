@@ -35,6 +35,7 @@ def try_continue_after_close(
     close: str,
     now: int,
     error_reason: str = "",
+    decision_completed_id: int = 0,
 ) -> bool:
     """If this close should restart the workflow, stage the
     continue-as-new on ``txn`` and return True.
@@ -101,7 +102,7 @@ def try_continue_after_close(
     else:
         expiration_ts = 0
     txn.add_continued_as_new(
-        0, now, str(uuid.uuid4()),
+        decision_completed_id, now, str(uuid.uuid4()),
         workflow_type=ei.workflow_type_name,
         task_list=ei.task_list,
         execution_start_to_close_timeout_seconds=ei.workflow_timeout,

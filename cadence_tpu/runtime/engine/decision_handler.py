@@ -230,6 +230,7 @@ class DecisionTaskHandler:
             restarted = try_continue_after_close(
                 self.txn, self.txn.ms, self.started_event_fn, close,
                 self.now, error_reason=reason,
+                decision_completed_id=self.completed_id,
             )
         except WorkflowStateError as e:
             raise DecisionFailure(_CAUSE_BAD_CONTINUE_AS_NEW, str(e))

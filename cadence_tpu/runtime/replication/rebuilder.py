@@ -104,25 +104,40 @@ class StateRebuilder:
             histories.append((r.workflow_id, r.run_id, self._read_batches(r)))
 
         try:
-            from cadence_tpu.ops.pack import PackError, pack_histories
-            from cadence_tpu.ops.replay import replay_packed
+            import jax  # noqa: F401 — device path needs a usable jax
+
+            from cadence_tpu.ops.dispatch import (
+                DeviceDispatcher,
+                DispatchError,
+            )
             from cadence_tpu.ops.unpack import state_row_to_mutable_state
         except Exception:  # jax unavailable — host path
             return [self.rebuild(r) for r in reqs]
 
-        try:
-            packed = pack_histories(histories)
-        except PackError:
-            return [self.rebuild(r) for r in reqs]
-
-        final = replay_packed(packed)
+        # storm drain: chunk the stream through the double-buffered
+        # host→device dispatcher (ops/dispatch.py) so packing batch k+1
+        # overlaps replaying batch k; each failed chunk (capacity
+        # overflow etc.) falls back per-workflow to the host oracle
+        chunk = 4096
         out: List[Tuple[MutableState, list, list]] = []
-        for i, r in enumerate(reqs):
-            ms = state_row_to_mutable_state(
-                final, i, packed.side[i],
-                domain_id=r.domain_id, epoch_s=packed.epoch_s,
-            )
-            ms.execution_info.branch_token = r.branch_token
-            transfer, timer = refresh_tasks(ms)
-            out.append((ms, transfer, timer))
+        d = DeviceDispatcher()
+        for i in range(0, len(reqs), chunk):
+            d.submit(i, histories[i : i + chunk])
+        d.finish()
+        for item in d.results(strict=False):
+            if isinstance(item, DispatchError):
+                i0 = item.batch_id
+                out.extend(
+                    self.rebuild(r) for r in reqs[i0 : i0 + chunk]
+                )
+                continue
+            i0, packed, final = item
+            for j, r in enumerate(reqs[i0 : i0 + chunk]):
+                ms = state_row_to_mutable_state(
+                    final, j, packed.side[j],
+                    domain_id=r.domain_id, epoch_s=packed.epoch_s,
+                )
+                ms.execution_info.branch_token = r.branch_token
+                transfer, timer = refresh_tasks(ms)
+                out.append((ms, transfer, timer))
         return out

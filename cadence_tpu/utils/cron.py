@@ -91,27 +91,45 @@ class CronSchedule:
         self.dom_star = parts[2] == "*"
         self.dow_star = parts[4] == "*"
 
+    def _day_matches(self, tm: time.struct_time) -> bool:
+        _, _, dom, month, dow = self.fields
+        if tm.tm_mon not in month:
+            return False
+        dom_ok = tm.tm_mday in dom
+        # cron encodes Sunday as 0; struct_tm wday has Monday == 0
+        dow_ok = ((tm.tm_wday + 1) % 7) in dow
+        if self.dom_star or self.dow_star:
+            return dom_ok and dow_ok
+        # both restricted: either matches (standard cron OR rule)
+        return dom_ok or dow_ok
+
     def next_delay_seconds(self, now_s: float) -> int:
         """Whole seconds from ``now_s`` (epoch) until the next fire; the
-        reference's GetCronBackoffDuration equivalent. Always > 0."""
+        reference's GetCronBackoffDuration equivalent. Always > 0.
+
+        Scans day-by-day (≤ ~1830 iterations over a 5-year horizon, the
+        same horizon robfig/cron uses) so sparse specs like a leap-day
+        '0 0 29 2 *' resolve without a minute-by-minute year walk.
+        """
         if self.every_seconds:
             return self.every_seconds
-        minute, hour, dom, month, dow = self.fields
-        # start at the next whole minute
-        t = (int(now_s) // 60 + 1) * 60
-        for _ in range(366 * 24 * 60):  # bounded: one year of minutes
-            tm = time.gmtime(t)
-            if tm.tm_mon in month and tm.tm_hour in hour and tm.tm_min in minute:
-                dom_ok = tm.tm_mday in dom
-                # cron encodes Sunday as 0; struct_tm as wday 6
-                dow_ok = ((tm.tm_wday + 1) % 7) in dow
-                if self.dom_star or self.dow_star:
-                    day_ok = dom_ok and dow_ok
-                else:
-                    day_ok = dom_ok or dow_ok
-                if day_ok:
-                    return max(1, t - int(now_s))
-            t += 60
+        minute, hour, _, _, _ = self.fields
+        minutes = sorted(minute)
+        hours = sorted(hour)
+        t = (int(now_s) // 60 + 1) * 60  # next whole minute
+        tm = time.gmtime(t)
+        # midnight of the starting day
+        day0 = t - tm.tm_hour * 3600 - tm.tm_min * 60 - tm.tm_sec
+        for day in range(366 * 5 + 1):
+            day_t = day0 + day * 86400
+            day_tm = time.gmtime(day_t)
+            if not self._day_matches(day_tm):
+                continue
+            for h in hours:
+                for m in minutes:
+                    fire = day_t + h * 3600 + m * 60
+                    if fire >= t:
+                        return max(1, fire - int(now_s))
         raise ValueError(f"cron spec {self.spec!r} never fires")
 
 

@@ -58,3 +58,14 @@ def test_next_delay_helper_swallows_bad_specs():
     assert next_cron_delay_seconds("", WED_4AM) == 0
     assert next_cron_delay_seconds("garbage", WED_4AM) == 0
     assert next_cron_delay_seconds("@every 3s", WED_4AM) == 3
+
+
+def test_sparse_specs_resolve_fast():
+    import time as _time
+
+    t0 = _time.monotonic()
+    # leap day: > 1 year out from mid-2025 (next is Feb 29 2028)
+    delay = CronSchedule("0 0 29 2 *").next_delay_seconds(WED_4AM)
+    assert delay > 300 * 24 * 3600
+    # and the scan is day-granular, not minute-granular
+    assert _time.monotonic() - t0 < 0.5

@@ -1,0 +1,83 @@
+"""Double-buffered host→device dispatch (ops/dispatch.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cadence_tpu.ops import schema as S
+from cadence_tpu.ops.dispatch import (
+    DeviceDispatcher,
+    DispatchError,
+    replay_stream,
+)
+from cadence_tpu.ops.pack import pack_histories
+from cadence_tpu.ops.replay import replay_scan
+from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+CAPS = S.Capacities(max_events=64)
+
+
+def _histories(n, seed=3):
+    fz = HistoryFuzzer(seed=seed, caps=CAPS)
+    return [
+        (f"wf-{seed}-{i}", f"run-{i}", fz.generate(target_events=24))
+        for i in range(n)
+    ]
+
+
+def _oneshot(histories):
+    packed = pack_histories(histories, caps=CAPS)
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(packed.batch, CAPS)
+    )
+    return packed, replay_scan(state0, jnp.asarray(packed.time_major()))
+
+
+def test_pipelined_stream_matches_oneshot():
+    hs = _histories(24)
+    got = replay_stream(hs, caps=CAPS, batch_size=8, depth=2)
+    assert len(got) == 3
+    for k, (packed, final) in enumerate(got):
+        _, want = _oneshot(hs[k * 8 : (k + 1) * 8])
+        for a, b in zip(
+            jax.tree_util.tree_leaves(final),
+            jax.tree_util.tree_leaves(want),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_results_preserve_submission_order():
+    d = DeviceDispatcher(caps=CAPS, depth=2)
+    for i in range(5):
+        d.submit(i, _histories(4, seed=i))
+    d.finish()
+    ids = [bid for bid, _, _ in d.results()]
+    assert ids == [0, 1, 2, 3, 4]
+
+
+def test_failed_batch_reported_and_stream_continues():
+    d = DeviceDispatcher(caps=CAPS, depth=2)
+    d.submit("ok-0", _histories(4))
+    d.submit("boom", [("wf", "run", "not event batches")])
+    d.submit("ok-1", _histories(4, seed=5))
+    d.finish()
+    seen = []
+    for item in d.results(strict=False):
+        if isinstance(item, DispatchError):
+            seen.append(("err", item.batch_id))
+        else:
+            seen.append(("ok", item[0]))
+    assert seen == [("ok", "ok-0"), ("err", "boom"), ("ok", "ok-1")]
+
+
+def test_strict_results_raise():
+    d = DeviceDispatcher(caps=CAPS)
+    d.submit("boom", [("wf", "run", 42)])
+    d.finish()
+    try:
+        list(d.results())
+        raise AssertionError("expected DispatchError")
+    except DispatchError as e:
+        assert e.batch_id == "boom"
