@@ -48,6 +48,7 @@ under "configs".
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import time
@@ -59,6 +60,14 @@ import numpy as np
 if "--cpu" in sys.argv:
     # the axon plugin bootstrap rewrites JAX_PLATFORMS; pin via config
     jax.config.update("jax_platforms", "cpu")
+
+# persistent compile cache: the deep-scan kernels take minutes to
+# compile on this host; cached binaries make reruns start in seconds
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def _build_histories(config: str, n_unique: int, caps):

@@ -122,13 +122,26 @@ def start_services(
         domains=domains, monitor=monitor,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
-    # first configured service's port wins
+    # first configured service's port wins, bound on that service's
+    # rpc host (a container binding rpc on 0.0.0.0 wants pprof there
+    # too). Diagnostics are non-essential: a bind failure logs and the
+    # service plane boots without them, as the reference does.
     for s in services:
         sc = cfg.services.get(s)
         if sc is not None and sc.pprof_port:
+            from cadence_tpu.utils.log import get_logger
             from cadence_tpu.utils.pprof import PProfServer
 
-            out.pprof = PProfServer(port=sc.pprof_port).start()
+            host = sc.rpc_address.rsplit(":", 1)[0] or "127.0.0.1"
+            try:
+                out.pprof = PProfServer(
+                    port=sc.pprof_port, host=host
+                ).start()
+            except OSError as e:
+                get_logger("cadence_tpu.pprof").warn(
+                    f"pprof endpoint {host}:{sc.pprof_port} failed to "
+                    f"bind ({e}); continuing without diagnostics"
+                )
             break
     out.domain_handler = DomainHandler(
         persistence.metadata, cluster_metadata

@@ -238,11 +238,14 @@ class TaskGC:
                 ack_level + 1,
             )
             self._last_deleted_level = ack_level
-        mgr._info.ack_level = ack_level
-        try:
-            mgr._store.update_task_list(mgr._info)
-        except ConditionFailedError:
-            pass  # lease moved; new owner persists its own ack level
+        # _write_lock: the writer thread swaps mgr._info on block
+        # rollover; persisting a stale range_id would self-fence
+        with mgr._write_lock:
+            mgr._info.ack_level = ack_level
+            try:
+                mgr._store.update_task_list(mgr._info)
+            except ConditionFailedError:
+                pass  # lease moved; new owner persists its own ack level
         self._since_gc = 0
         self._last_gc = mgr._time.now()
 

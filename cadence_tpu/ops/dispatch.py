@@ -63,6 +63,8 @@ class DeviceDispatcher:
             target=self._run_pump, name="dispatch-run", daemon=True
         )
         self._started = False
+        self._finished = False
+        self._drained = False
 
     # -- producer side --------------------------------------------------
 
@@ -75,8 +77,11 @@ class DeviceDispatcher:
         self._in.put((batch_id, histories))
 
     def finish(self) -> None:
-        """No more submits; results() ends after the queued work."""
-        self._in.put(None)
+        """No more submits; results() ends after the queued work.
+        Idempotent."""
+        if not self._finished:
+            self._finished = True
+            self._in.put(None)
 
     # -- pipeline stages -------------------------------------------------
 
@@ -173,6 +178,7 @@ class DeviceDispatcher:
         while True:
             item = self._out.get()
             if item is None:
+                self._drained = True
                 return
             if isinstance(item, DispatchError):
                 if strict:
@@ -185,12 +191,13 @@ class DeviceDispatcher:
         return self
 
     def __exit__(self, *exc) -> None:
-        if self._started:
-            self.finish()
-            # drain so the pumps exit even on abnormal exit
-            while True:
-                if self._out.get() is None:
-                    break
+        if not self._started or self._drained:
+            return
+        self.finish()
+        # drain so the pumps exit even on abnormal exit
+        while self._out.get() is not None:
+            pass
+        self._drained = True
 
 
 def replay_stream(

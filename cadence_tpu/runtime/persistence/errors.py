@@ -48,5 +48,8 @@ class DomainAlreadyExistsError(PersistenceError):
     pass
 
 
-class TaskListLeaseLostError(PersistenceError):
-    """Task-list range_id condition failed — another matching host owns it."""
+class TaskListLeaseLostError(ConditionFailedError):
+    """Task-list range_id condition failed — another matching host owns
+    it. A ConditionFailedError so lease-fencing recovery paths (the
+    task writer's re-lease-and-retry, taskGC's ack-level suppression)
+    catch it with the rest of the optimistic-concurrency family."""

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Render the config template from env and start the requested services.
+# Reference: docker/entrypoint.sh + start-cadence.sh (BIND_ON_IP
+# resolution, config templating, exec the server).
+set -e
+
+: "${BIND_ON_IP:=$(hostname -i 2>/dev/null | awk '{print $1}')}"
+: "${BIND_ON_IP:=127.0.0.1}"
+: "${SQLITE_PATH:=/data/cadence_tpu.db}"
+: "${NUM_HISTORY_SHARDS:=16}"
+: "${FRONTEND_SEEDS:=${BIND_ON_IP}:7833}"
+: "${HISTORY_SEEDS:=${BIND_ON_IP}:7834}"
+: "${MATCHING_SEEDS:=${BIND_ON_IP}:7835}"
+export BIND_ON_IP SQLITE_PATH NUM_HISTORY_SHARDS
+export FRONTEND_SEEDS HISTORY_SEEDS MATCHING_SEEDS
+
+TEMPLATE="${CADENCE_TPU_CONFIG:-docker/config_template.yaml}"
+RENDERED="/tmp/cadence_tpu_config.yaml"
+
+python -m cadence_tpu.config.render "$TEMPLATE" "$RENDERED"
+
+SERVICES=$(echo "$@" | tr ' ' ',')
+exec python -m cadence_tpu.tools.cli server \
+    --config "$RENDERED" --services "$SERVICES"

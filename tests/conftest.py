@@ -6,16 +6,16 @@ sharding is validated on virtual CPU devices.
 """
 
 import os
+import sys
 
 # Force CPU: the ambient environment may point JAX at a tunneled TPU
 # backend (JAX_PLATFORMS=axon) whose initialization can block; tests always
-# run on the virtual 8-device CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# run on the virtual 8-device CPU mesh. The env contract lives in
+# testing/environment.py (the reference environment/env.go equivalent).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from cadence_tpu.testing.environment import setup_env  # noqa: E402
+
+setup_env()
 
 import jax  # noqa: E402
 

@@ -183,3 +183,52 @@ class TestBootstrap:
         finally:
             b.stop()
             a.stop()
+
+
+def test_docker_template_renders_and_parses(tmp_path):
+    """docker/entrypoint.sh's renderer + the shipped template produce a
+    loadable config (reference docker/config_template.yaml contract)."""
+    import os
+    from cadence_tpu.config.render import render_template
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    template = open(os.path.join(root, "docker", "config_template.yaml")).read()
+    env = {
+        "BIND_ON_IP": "0.0.0.0",
+        "SQLITE_PATH": str(tmp_path / "d.db"),
+        "NUM_HISTORY_SHARDS": "16",
+        "FRONTEND_SEEDS": "frontend:7833",
+        "HISTORY_SEEDS": "history:7834,history-2:7834",
+        "MATCHING_SEEDS": "matching:7835",
+    }
+    # the exact renderer docker/entrypoint.sh invokes
+    rendered = tmp_path / "rendered.yaml"
+    rendered.write_text(render_template(template, env))
+
+    from cadence_tpu.config import load_config
+
+    cfg = load_config(str(rendered))
+    assert cfg.services["frontend"].rpc_address == "0.0.0.0:7833"
+    assert cfg.services["frontend"].pprof_port == 7936
+    assert cfg.ring.bootstrap_hosts["history"] == [
+        "history:7834", "history-2:7834",
+    ]
+    assert cfg.persistence.num_history_shards == 16
+
+
+def test_environment_module_defaults(monkeypatch):
+    """environment.py resolves backends from env (reference
+    environment/env.go)."""
+    from cadence_tpu.testing import environment as E
+
+    monkeypatch.delenv(E.STORE, raising=False)
+    assert E.store() == "memory"
+    assert E.create_bundle().execution is not None
+
+    monkeypatch.setenv(E.NUM_SHARDS, "9")
+    assert E.num_shards() == 9
+
+    env = {"XLA_FLAGS": ""}
+    E.setup_env(env)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "device_count=8" in env["XLA_FLAGS"]

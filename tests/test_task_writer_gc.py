@@ -116,3 +116,40 @@ def test_gc_is_throttled():
         )
     finally:
         mgr.stop()
+
+
+def test_writer_relases_after_lease_theft():
+    """create_tasks raising the lease-fencing error triggers re-lease +
+    retry (reference taskWriter block fencing), not a producer failure."""
+    from cadence_tpu.runtime.persistence.errors import TaskListLeaseLostError
+
+    inner = create_memory_bundle().task
+
+    class _StealOnce:
+        def __init__(self):
+            self.stole = False
+
+        def __getattr__(self, name):
+            return getattr(inner, name)
+
+        def create_tasks(self, info, tasks):
+            if not self.stole:
+                self.stole = True
+                # another host bumps the lease out from under us
+                inner.lease_task_list("dom", "writer-tl", TASK_TYPE_DECISION)
+                raise TaskListLeaseLostError("stolen")
+            return inner.create_tasks(info, tasks)
+
+    store = _StealOnce()
+    mgr = _mgr(store)
+    try:
+        mgr.add_task(
+            TaskInfo(domain_id="dom", workflow_id="wf", run_id="run",
+                     task_id=0, schedule_id=7)
+        )
+        assert store.stole
+        task = mgr.get_task(timeout=5.0)
+        assert task is not None and task.info.schedule_id == 7
+        task.finish(None)
+    finally:
+        mgr.stop()
