@@ -9,6 +9,12 @@ IDL compilation step is needed.
 
 from .codec import decode, encode
 from .server import FrontendRPCServer
-from .client import RemoteFrontend
+from .client import RemoteClusterRPCClient, RemoteFrontend
 
-__all__ = ["decode", "encode", "FrontendRPCServer", "RemoteFrontend"]
+__all__ = [
+    "decode",
+    "encode",
+    "FrontendRPCServer",
+    "RemoteClusterRPCClient",
+    "RemoteFrontend",
+]

@@ -108,3 +108,39 @@ class RemoteMatching(RemoteService):
     """Dial a matching host; same surface as a MatchingEngine."""
 
     _service = "cadence_tpu.Matching"
+
+
+class RemoteClusterRPCClient:
+    """Cross-cluster replication transport: the DCN pull plane.
+
+    Implements the fetcher's RemoteClusterClient contract
+    (runtime/replication/processor.py) over the gRPC history endpoint
+    of a host in the SOURCE cluster — the consumer cluster's fetchers
+    dial the source and drain its replicator queue, exactly the
+    reference's admin client GetReplicationMessages over the cross-DC
+    connection (client/admin + common/rpc dispatching on
+    ClusterInformation rpc addresses).
+    """
+
+    def __init__(self, address: str, consumer_cluster: str) -> None:
+        self._stub = RemoteHistory(address)
+        self.address = address
+        self.consumer_cluster = consumer_cluster
+
+    def get_replication_messages(
+        self, shard_id: int, last_retrieved_id: int
+    ):
+        return self._stub.get_replication_messages(
+            shard_id, last_retrieved_id, self.consumer_cluster
+        )
+
+    def get_workflow_history_raw(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        start_event_id: int, end_event_id: int,
+    ):
+        return self._stub.get_workflow_history_raw(
+            domain_id, workflow_id, run_id, start_event_id, end_event_id
+        )
+
+    def close(self) -> None:
+        self._stub.close()

@@ -28,8 +28,14 @@ def _register_defaults() -> None:
     from cadence_tpu.matching.engine import PollRequest
     from cadence_tpu.runtime import api as A
     from cadence_tpu.runtime.persistence import records as R
+    from cadence_tpu.runtime.replication.messages import (
+        HistoryTaskV2,
+        ReplicationMessages,
+    )
 
     for cls in (
+        HistoryTaskV2,
+        ReplicationMessages,
         PollRequest,
         A.StartWorkflowRequest,
         A.SignalRequest,

@@ -142,7 +142,9 @@ class ReplicationTaskProcessor:
             applied += 1
         return applied
 
-    def drain(self, max_rounds: int = 100) -> int:
+    def drain_tasks(self, max_rounds: int = 100) -> int:
+        """Pull+apply until a fetch comes back empty; returns the task
+        count (test/assembly harness surface)."""
         total = 0
         for _ in range(max_rounds):
             n = self.process_once()
@@ -150,6 +152,15 @@ class ReplicationTaskProcessor:
             if n == 0:
                 return total
         return total
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Queue-processor drain contract (HistoryService.drain_queues):
+        True when the remote stream is quiescent within the budget."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.process_once() == 0:
+                return True
+        return False
 
     def _process_task(self, task: HistoryTaskV2) -> None:
         for attempt in range(self.max_retry):

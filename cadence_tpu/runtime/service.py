@@ -8,7 +8,7 @@ pushes, and a history client for cross-shard workflow calls.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from cadence_tpu.utils.clock import TimeSource
 from cadence_tpu.utils.log import get_logger
@@ -54,6 +54,9 @@ class HistoryService:
         # processors need clients; clients need the controller)
         self.matching_client = None
         self.history_client = None
+        # remote-cluster pull plane: cluster -> (client, fetcher);
+        # each owned shard gets a ReplicationTaskProcessor per entry
+        self._replication_sources: Dict[str, tuple] = {}
         # remote clusters this host stands by for (standby queue planes)
         self.standby_clusters: List[str] = []
         if cluster_metadata is not None:
@@ -114,9 +117,38 @@ class HistoryService:
             ))
         engine._task_notifier = lambda: [n() for n in notifiers]
         engine._timer_notifier = lambda: [n() for n in timer_notifiers]
+        # pull-replication consumers: one per registered source cluster
+        # (reference replicationTaskProcessor per shard per remote).
+        # AFTER the notifier assignment: touching engine.ndc_replicator
+        # materializes it with whatever notifiers exist at that moment
+        for cluster, (client, fetcher) in self._replication_sources.items():
+            from .replication import (
+                HistoryRereplicator,
+                ReplicationTaskProcessor,
+            )
+
+            rerepl = HistoryRereplicator(client, engine.ndc_replicator)
+            processors.append(
+                ReplicationTaskProcessor(
+                    shard, engine.ndc_replicator, fetcher,
+                    rereplicator=rerepl,
+                )
+            )
         for p in processors:
             p.start()
         return _ShardHandle(shard, engine, processors)
+
+    def enable_replication_from(self, cluster: str, client) -> None:
+        """Register a remote source cluster's pull client (an in-proc
+        adapter or rpc.RemoteClusterRPCClient) BEFORE start(): every
+        owned shard then runs a ReplicationTaskProcessor draining that
+        cluster's replicator queue (reference replicationTaskFetcher +
+        replicationTaskProcessor assembly, service/history/service.go)."""
+        from .replication import ReplicationTaskFetcher
+
+        self._replication_sources[cluster] = (
+            client, ReplicationTaskFetcher(cluster, client)
+        )
 
     def _on_domain_failover(
         self, domain_id: str, old_cluster: str, new_cluster: str

@@ -280,6 +280,78 @@ def cmd_workflow(args) -> None:
         _print({"count": fe.count_workflow_executions(
             args.domain, args.query or ""
         )})
+    elif wc == "signalwithstart":
+        from cadence_tpu.runtime.api import SignalWithStartRequest
+
+        run_id = fe.signal_with_start_workflow_execution(
+            SignalWithStartRequest(
+                start=StartWorkflowRequest(
+                    domain=args.domain, workflow_id=args.workflow_id,
+                    workflow_type=args.type, task_list=args.tasklist,
+                    input=(args.input or "").encode(),
+                    execution_start_to_close_timeout_seconds=args.timeout,
+                    cron_schedule=args.cron or "",
+                ),
+                signal_name=args.name,
+                signal_input=(args.signal_input or "").encode(),
+            )
+        )
+        _print({"run_id": run_id})
+    elif wc == "observe":
+        # reference workflowCommands.go ObserveHistory: long-poll the
+        # history from the last seen event (the server blocks until new
+        # events land — no full re-fetch, no client-side poll loop)
+        from cadence_tpu.core.enums import EventType
+
+        terminal = {
+            EventType.WorkflowExecutionCompleted,
+            EventType.WorkflowExecutionFailed,
+            EventType.WorkflowExecutionTimedOut,
+            EventType.WorkflowExecutionCanceled,
+            EventType.WorkflowExecutionTerminated,
+            EventType.WorkflowExecutionContinuedAsNew,
+        }
+        printed = 0
+        deadline = time.monotonic() + args.timeout
+        while True:
+            events, _ = fe.get_workflow_execution_history(
+                args.domain, args.workflow_id, args.run_id or "",
+                first_event_id=printed + 1, wait_for_new_event=True,
+            )
+            for e in events:
+                print(f"{e.event_id:5d}  {e.event_type.name}")
+                printed = max(printed, e.event_id)
+            if events and events[-1].event_type in terminal:
+                _print({"closed": True, "events": printed})
+                return
+            if time.monotonic() > deadline:
+                _print({"closed": False, "events": printed})
+                return
+    elif wc == "export":
+        # full-fidelity history dump (admin history-dump depth): every
+        # event with all attributes, replayable JSON
+        events, _ = fe.get_workflow_execution_history(
+            args.domain, args.workflow_id, args.run_id or ""
+        )
+        payload = json.dumps(
+            [
+                {
+                    "event_id": e.event_id,
+                    "event_type": e.event_type.name,
+                    "version": e.version,
+                    "timestamp": e.timestamp,
+                    "attributes": e.attributes,
+                }
+                for e in events
+            ],
+            indent=2, default=_default,
+        )
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(payload)
+            _print({"exported": len(events), "to": args.output})
+        else:
+            print(payload)
 
 
 # -- tasklist / admin / batch --------------------------------------------
@@ -394,7 +466,8 @@ def build_parser() -> argparse.ArgumentParser:
     w = sub.add_parser("workflow")
     wsub = w.add_subparsers(dest="workflow_cmd", required=True)
     for name in ("start", "show", "describe", "signal", "terminate",
-                 "cancel", "reset", "query", "list", "count"):
+                 "cancel", "reset", "query", "list", "count",
+                 "signalwithstart", "observe", "export"):
         wp = wsub.add_parser(name)
         wp.add_argument("--domain", required=True)
         if name not in ("list", "count"):
@@ -410,6 +483,9 @@ def build_parser() -> argparse.ArgumentParser:
         wp.add_argument("--event-id", type=int, default=0)
         wp.add_argument("--timeout", type=int, default=60)
         wp.add_argument("--page-size", type=int, default=100)
+        wp.add_argument("--signal-input", default="")
+        wp.add_argument("--output", default="",
+                        help="export: write history JSON here")
     w.set_defaults(fn=cmd_workflow)
 
     t = sub.add_parser("tasklist")

@@ -164,7 +164,7 @@ def _run_storm(n_workflows, record=None):
                 return orig_one(req)
 
             rb.rebuild_many, rb.rebuild = spy_many, spy_one
-        applied = proc.drain()
+        applied = proc.drain_tasks()
         assert applied == len(tasks)
         return _snapshot_all(box, wfs)
     finally:
@@ -238,7 +238,7 @@ def test_cross_run_tasks_queue_behind_deferred_rebuild():
         fetcher = ReplicationTaskFetcher("active", _QueueClient(tasks))
         ReplicationTaskProcessor(
             self_shard(box), box.engine.ndc_replicator, fetcher
-        ).drain()
+        ).drain_tasks()
         got = {run: _snapshot_all(box, [(wf, run)]) for wf, run in runs}
         got_current = current_run(box, runs[0][0])
     finally:

@@ -1,0 +1,164 @@
+"""Cross-cluster replication over the REAL gRPC transport (DCN plane).
+
+tests/test_xdc_replication.py wires the standby's fetcher to the active
+cluster in-process; here the same pull plane crosses an actual gRPC
+endpoint via RemoteClusterRPCClient — the reference's admin-client
+GetReplicationMessages over the cross-DC connection. Covers: message
+batches (nested HistoryTaskV2/HistoryEvent) surviving the wire codec,
+cursor-ack pull semantics, and raw-history re-replication fetches.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import pytest
+
+from tests.test_xdc_replication import (
+    Cluster,
+    DOMAIN,
+    NUM_SHARDS,
+    _decide,
+)
+
+from cadence_tpu.core.enums import DecisionType, EventType
+from cadence_tpu.rpc import RemoteClusterRPCClient
+from cadence_tpu.rpc.server import HistoryRPCServer
+from cadence_tpu.runtime.api import Decision, StartWorkflowRequest
+from cadence_tpu.runtime.replication import (
+    HistoryRereplicator,
+    ReplicationTaskFetcher,
+    ReplicationTaskProcessor,
+)
+
+
+class GrpcHarness:
+    def __init__(self):
+        domain_id = str(uuid.uuid4())
+        self.active = Cluster("active", domain_id, "active")
+        self.standby = Cluster("standby", domain_id, "active")
+        # the active cluster's history endpoint, served for real
+        self.server = HistoryRPCServer(self.active.history).start()
+        self.client = RemoteClusterRPCClient(
+            self.server.address, consumer_cluster="standby"
+        )
+        self.fetcher = ReplicationTaskFetcher("active", self.client)
+        self.processors = []
+        for shard_id in range(NUM_SHARDS):
+            engine = self.standby.history.controller.get_engine_for_shard(
+                shard_id
+            )
+            rerepl = HistoryRereplicator(
+                self.client, engine.ndc_replicator
+            )
+            self.processors.append(
+                ReplicationTaskProcessor(
+                    engine.shard, engine.ndc_replicator,
+                    self.fetcher, rereplicator=rerepl,
+                )
+            )
+
+    def replicate_all(self) -> int:
+        return sum(p.drain_tasks() for p in self.processors)
+
+    def stop(self):
+        self.client.close()
+        self.server.stop()
+        self.active.stop()
+        self.standby.stop()
+
+
+@pytest.fixture()
+def wire():
+    h = GrpcHarness()
+    yield h
+    h.stop()
+
+
+def test_replication_crosses_grpc(wire):
+    run_id = wire.active.history_client.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=DOMAIN, workflow_id="wire-wf", workflow_type="echo",
+            task_list="tl",
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    _decide(
+        wire.active, "tl",
+        [Decision(DecisionType.CompleteWorkflowExecution,
+                  {"result": b"over-dcn"})],
+    )
+    assert wire.active.history.drain_queues()
+    assert wire.replicate_all() >= 2
+
+    active_engine = wire.active.history.controller.get_engine("wire-wf")
+    standby_engine = wire.standby.history.controller.get_engine("wire-wf")
+    a_events, _ = active_engine.get_workflow_execution_history(
+        DOMAIN, "wire-wf", run_id
+    )
+    s_events, _ = standby_engine.get_workflow_execution_history(
+        DOMAIN, "wire-wf", run_id
+    )
+    assert [(e.event_id, e.event_type, e.version) for e in a_events] == [
+        (e.event_id, e.event_type, e.version) for e in s_events
+    ]
+    assert s_events[-1].event_type == EventType.WorkflowExecutionCompleted
+    assert s_events[-1].attributes["result"] == b"over-dcn"
+
+
+def test_pull_cursor_advances_over_wire(wire):
+    wire.active.history_client.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=DOMAIN, workflow_id="wire-wf2", workflow_type="echo",
+            task_list="tl",
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    first = wire.replicate_all()
+    assert first >= 1
+    # everything acked: a second drain pulls nothing
+    assert wire.replicate_all() == 0
+
+
+def test_service_level_replication_wiring():
+    """enable_replication_from: the standby HistoryService runs its own
+    pull processors against the active cluster's gRPC endpoint — no
+    manual fetcher assembly, convergence happens in the background."""
+    import time
+
+    domain_id = str(uuid.uuid4())
+    active = Cluster("active", domain_id, "active")
+    server = HistoryRPCServer(active.history).start()
+    client = RemoteClusterRPCClient(server.address,
+                                    consumer_cluster="standby")
+    standby = Cluster("standby", domain_id, "active", start=False)
+    standby.history.enable_replication_from("active", client)
+    standby.history.start()
+    try:
+        run_id = active.history_client.start_workflow_execution(
+            StartWorkflowRequest(
+                domain=DOMAIN, workflow_id="auto-wf",
+                workflow_type="echo", task_list="tl",
+                execution_start_to_close_timeout_seconds=60,
+            )
+        )
+        deadline = time.monotonic() + 15
+        events = None
+        while time.monotonic() < deadline:
+            try:
+                engine = standby.history.controller.get_engine("auto-wf")
+                events, _ = engine.get_workflow_execution_history(
+                    DOMAIN, "auto-wf", run_id
+                )
+                if events:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert events, "replication never converged over gRPC"
+        assert events[0].event_type == EventType.WorkflowExecutionStarted
+    finally:
+        client.close()
+        server.stop()
+        active.stop()
+        standby.stop()

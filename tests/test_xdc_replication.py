@@ -47,7 +47,8 @@ def _cluster_metadata(current: str) -> ClusterMetadata:
 
 
 class Cluster:
-    def __init__(self, name: str, domain_id: str, active_cluster: str):
+    def __init__(self, name: str, domain_id: str, active_cluster: str,
+                 start: bool = True):
         self.name = name
         self.persistence = create_memory_bundle()
         self.domain_id = register_domain(
@@ -68,7 +69,8 @@ class Cluster:
         self.matching = MatchingEngine(self.persistence.task, self.history_client)
         self.matching_client = MatchingClient(self.matching)
         self.history.wire(self.matching_client, self.history_client)
-        self.history.start()
+        if start:
+            self.history.start()
 
     def stop(self):
         self.history.stop()
@@ -113,7 +115,7 @@ class Harness:
             )
 
     def replicate_all(self) -> int:
-        return sum(p.drain() for p in self.processors)
+        return sum(p.drain_tasks() for p in self.processors)
 
     def stop(self):
         self.active.stop()
