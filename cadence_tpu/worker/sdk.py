@@ -146,6 +146,21 @@ class _LocalActivityCmd:
     input: bytes
 
 
+@dataclasses.dataclass
+class _SideEffectCmd:
+    fn: Callable[[], bytes]
+
+
+@dataclasses.dataclass
+class _GetVersionCmd:
+    change_id: str
+    min_supported: int
+    max_supported: int
+
+
+DEFAULT_VERSION = -1  # reference client.DefaultVersion: pre-change code
+
+
 class WorkflowContext:
     """Command factory handed to workflow code."""
 
@@ -221,6 +236,22 @@ class WorkflowContext:
         round-trip through matching)."""
         return _LocalActivityCmd(activity_type, input)
 
+    def side_effect(self, fn: Callable[[], bytes]) -> _SideEffectCmd:
+        """Record a non-deterministic value (uuid, random, clock read)
+        once; replay returns the recorded bytes without re-running fn
+        (reference workflow.SideEffect marker semantics)."""
+        return _SideEffectCmd(fn)
+
+    def get_version(
+        self, change_id: str, min_supported: int, max_supported: int,
+    ) -> _GetVersionCmd:
+        """Safe workflow-code versioning (reference workflow.GetVersion):
+        the first execution through this point records max_supported in
+        a version marker; replays of histories recorded before the
+        change see DEFAULT_VERSION (-1); replays of recorded versions
+        outside [min_supported, max_supported] fail as non-determinism."""
+        return _GetVersionCmd(change_id, min_supported, max_supported)
+
 
 # -- history → replay state -----------------------------------------------
 
@@ -251,9 +282,17 @@ class _ReplayState:
         # cancel request on THIS run
         self.cancel_requested = False
         self.cancel_cause: bytes = b""
-        # markers in record order (local-activity results replay from here)
-        self.markers: List[Tuple[str, bytes]] = []
+        # markers by kind, each in record order (replay consumes each
+        # stream independently; names disambiguate misuse)
+        self.local_markers: List[Tuple[str, bytes]] = []
+        self.side_effect_markers: List[bytes] = []
+        self.version_markers: Dict[str, int] = {}
         self.upsert_count = 0
+        # replay frontier detection for GetVersion/SideEffect: a history
+        # with completed decisions is a replay until the driver crosses
+        # into new territory (emits a decision, blocks, or has consumed
+        # every recorded outcome)
+        self.completed_decisions = 0
 
         sched_to_aid: Dict[int, str] = {}
         init_to_child: Dict[int, str] = {}
@@ -338,11 +377,45 @@ class _ReplayState:
                     cause.encode() if isinstance(cause, str) else cause
                 )
             elif et == EventType.MarkerRecorded:
-                self.markers.append(
-                    (a.get("marker_name", ""), a.get("details", b"") or b"")
-                )
+                name = a.get("marker_name", "")
+                details = a.get("details", b"") or b""
+                if name.startswith("version:"):
+                    try:
+                        self.version_markers[name[len("version:"):]] = int(
+                            details.decode()
+                        )
+                    except ValueError:
+                        pass
+                elif name == "side_effect":
+                    self.side_effect_markers.append(details)
+                else:
+                    self.local_markers.append((name, details))
             elif et == EventType.UpsertWorkflowSearchAttributes:
                 self.upsert_count += 1
+            elif et == EventType.DecisionTaskCompleted:
+                self.completed_decisions += 1
+
+    def total_outcomes(self) -> int:
+        """Recorded command outcomes available to replay. The driver is
+        'replaying' until they are all consumed — past that point the
+        workflow code is executing for the first time.
+
+        Signals and cancel requests are deliberately NOT counted: they
+        buffer before the workflow reads them (a delivered-but-unread
+        signal is not evidence of code progress), so counting them
+        would misclassify genuinely-new code at the frontier as a
+        replay. The cost is weaker old-history detection for runs whose
+        recorded progress is purely signal-driven — the reference SDK
+        resolves this with exact event positions; this build errs
+        toward 'executing', which records rather than fails."""
+        return (
+            len(self.activity_outcome)
+            + len(self.timers_fired)
+            + len(self.child_outcome_by_index)
+            + len(self.local_markers)
+            + len(self.side_effect_markers)
+            + len(self.version_markers)
+        )
 
 
 # -- the replay runner ----------------------------------------------------
@@ -356,11 +429,28 @@ class _Driver:
         self.fn = fn
         self.state = state
         self.decisions: List[Decision] = []
-        self.seq = {"a": 0, "t": 0, "c": 0, "s": 0, "rc": 0, "m": 0}
+        self.seq = {"a": 0, "t": 0, "c": 0, "s": 0, "rc": 0, "m": 0,
+                    "se": 0}
+        # versions resolved THIS replay that have no history marker yet
+        self._version_cache: Dict[str, int] = {}
         self.signal_cursor: Dict[str, int] = {}
         self.closed = False
         # executes local activities inline (activity_type, input) -> bytes
         self.local_executor = local_executor
+        # replay frontier: the run is a replay while recorded outcomes
+        # remain unconsumed; emitting a decision or blocking also
+        # crosses into new execution (matches the reference SDK's
+        # isReplaying transition at the last DecisionTaskStarted)
+        self._crossed = state.completed_decisions == 0
+        self._total_outcomes = state.total_outcomes()
+        self._consumed = 0
+
+    @property
+    def replaying(self) -> bool:
+        return not self._crossed and self._consumed < self._total_outcomes
+
+    def _consume(self) -> None:
+        self._consumed += 1
 
     def _next_id(self, kind: str) -> str:
         self.seq[kind] += 1
@@ -425,11 +515,20 @@ class _Driver:
 
     def _handle(self, cmd) -> Tuple[Any, Optional[BaseException], bool]:
         """Returns (value_to_send, exc_to_throw, blocked)."""
+        before = len(self.decisions)
+        out = self._handle_inner(cmd)
+        if out[2] or len(self.decisions) > before:
+            # crossed the frontier: subsequent code is NEW execution
+            self._crossed = True
+        return out
+
+    def _handle_inner(self, cmd) -> Tuple[Any, Optional[BaseException], bool]:
         st = self.state
         if isinstance(cmd, _ActivityCmd):
             aid = cmd.activity_id or self._next_id("a")
             outcome = st.activity_outcome.get(aid)
             if outcome is not None:
+                self._consume()
                 if outcome[0] == "completed":
                     return outcome[1], None, False
                 return None, ActivityError(outcome[1], outcome[2]), False
@@ -453,6 +552,7 @@ class _Driver:
         if isinstance(cmd, _TimerCmd):
             tid = cmd.timer_id or self._next_id("t")
             if tid in st.timers_fired:
+                self._consume()
                 return None, None, False
             if tid not in st.timers_started:
                 self.decisions.append(
@@ -485,6 +585,7 @@ class _Driver:
             self.seq["c"] += 1
             outcome = st.child_outcome_by_index.get(child_idx)
             if outcome is not None:
+                self._consume()
                 if outcome[0] == "completed":
                     return outcome[1], None, False
                 return None, ActivityError(outcome[1]), False
@@ -557,15 +658,27 @@ class _Driver:
         if isinstance(cmd, _LocalActivityCmd):
             m_idx = self.seq["m"]
             self.seq["m"] += 1
-            if m_idx < len(st.markers):
-                return st.markers[m_idx][1], None, False
+            if m_idx < len(st.local_markers):
+                name, details = st.local_markers[m_idx]
+                want = f"local_activity:{cmd.activity_type}"
+                if name != want:
+                    raise _NonDeterminismError(
+                        f"marker {m_idx} is {name!r}, workflow code "
+                        f"asked for {want!r}"
+                    )
+                self._consume()
+                return details, None, False
             if self.local_executor is None:
                 raise _NonDeterminismError(
                     "local activity yielded but no executor is wired "
                     "(replay_decide without a DecisionWorker)"
                 )
             result = self.local_executor(cmd.activity_type, cmd.input)
-            result = result if isinstance(result, bytes) else b""
+            if not isinstance(result, bytes):
+                raise TypeError(
+                    f"local activity {cmd.activity_type!r} must return "
+                    f"bytes, got {type(result).__name__}"
+                )
             self.decisions.append(
                 Decision(
                     DecisionType.RecordMarker,
@@ -574,6 +687,67 @@ class _Driver:
                 )
             )
             return result, None, False
+        if isinstance(cmd, _SideEffectCmd):
+            se_idx = self.seq["se"]
+            self.seq["se"] += 1
+            if se_idx < len(st.side_effect_markers):
+                self._consume()
+                return st.side_effect_markers[se_idx], None, False
+            if self.replaying:
+                raise _NonDeterminismError(
+                    "side effect has no recorded marker during replay — "
+                    "gate new side effects behind ctx.get_version"
+                )
+            result = cmd.fn()
+            if not isinstance(result, bytes):
+                raise TypeError(
+                    "side_effect fn must return bytes, got "
+                    f"{type(result).__name__}"
+                )
+            self.decisions.append(
+                Decision(
+                    DecisionType.RecordMarker,
+                    {"marker_name": "side_effect", "details": result},
+                )
+            )
+            return result, None, False
+        if isinstance(cmd, _GetVersionCmd):
+            recorded = st.version_markers.get(cmd.change_id)
+            if recorded is not None and cmd.change_id not in (
+                self._version_cache
+            ):
+                # each recorded change counts once toward the frontier
+                self._version_cache[cmd.change_id] = recorded
+                self._consume()
+            if recorded is None and cmd.change_id in self._version_cache:
+                recorded = self._version_cache[cmd.change_id]
+            if recorded is not None:
+                if not cmd.min_supported <= recorded <= cmd.max_supported:
+                    raise _NonDeterminismError(
+                        f"history recorded version {recorded} for change "
+                        f"{cmd.change_id!r}, workflow code supports "
+                        f"[{cmd.min_supported}, {cmd.max_supported}]"
+                    )
+                return recorded, None, False
+            if self.replaying:
+                # history predates this GetVersion point: old behavior
+                if cmd.min_supported > DEFAULT_VERSION:
+                    raise _NonDeterminismError(
+                        f"history predates change {cmd.change_id!r} but "
+                        f"min_supported={cmd.min_supported} drops the "
+                        "pre-change path"
+                    )
+                self._version_cache[cmd.change_id] = DEFAULT_VERSION
+                return DEFAULT_VERSION, None, False
+            self._version_cache[cmd.change_id] = cmd.max_supported
+            self.decisions.append(
+                Decision(
+                    DecisionType.RecordMarker,
+                    {"marker_name": f"version:{cmd.change_id}",
+                     "details": str(cmd.max_supported).encode()},
+                )
+            )
+            return cmd.max_supported, None, False
         if isinstance(cmd, _ContinueAsNewCmd):
             self.decisions.append(
                 Decision(

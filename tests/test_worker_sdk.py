@@ -227,3 +227,148 @@ def test_query_handler_through_worker(box):
         assert out == b"answer:depth"
     finally:
         w.stop()
+
+
+def test_side_effect_recorded_once(box):
+    """ctx.side_effect runs once; later decisions replay the marker
+    (reference workflow.SideEffect)."""
+    calls = []
+
+    def wf(ctx, input):
+        token = yield ctx.side_effect(lambda: (
+            calls.append(1), b"se-%d" % len(calls))[1])
+        # a real command forces a second decision cycle, which replays
+        # the side effect from its marker
+        yield ctx.start_timer(1)
+        token2 = yield ctx.side_effect(lambda: (
+            calls.append(1), b"se-%d" % len(calls))[1])
+        return token + b"|" + token2
+
+    w = _worker(box)
+    w.register_workflow("se-wf", wf)
+    w.start()
+    try:
+        run = _start(box, "se-1", "se-wf")
+        _wait_closed(box, "se-1", run)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "se-1", run
+        )
+        assert events[-1].attributes["result"] == b"se-1|se-2"
+        markers = [e for e in events
+                   if e.event_type == EventType.MarkerRecorded]
+        assert len(markers) == 2
+        # each side effect executed exactly once despite multiple replays
+        assert len(calls) == 2
+    finally:
+        w.stop()
+
+
+def test_get_version_records_and_replays(box):
+    """ctx.get_version pins max_supported at first execution and replays
+    it thereafter (reference workflow.GetVersion)."""
+    seen = []
+
+    def wf(ctx, input):
+        v = yield ctx.get_version("change-a", -1, 2)
+        seen.append(v)
+        yield ctx.start_timer(1)
+        v2 = yield ctx.get_version("change-a", -1, 2)
+        seen.append(v2)
+        return b"v=%d,%d" % (v, v2)
+
+    w = _worker(box)
+    w.register_workflow("ver-wf", wf)
+    w.start()
+    try:
+        run = _start(box, "ver-1", "ver-wf")
+        _wait_closed(box, "ver-1", run)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "ver-1", run
+        )
+        assert events[-1].attributes["result"] == b"v=2,2"
+        version_markers = [
+            e for e in events
+            if e.event_type == EventType.MarkerRecorded
+            and e.attributes["marker_name"] == "version:change-a"
+        ]
+        assert len(version_markers) == 1
+        assert all(v == 2 for v in seen)
+    finally:
+        w.stop()
+
+
+def test_get_version_old_history_sees_default():
+    """A history recorded BEFORE a GetVersion point replays as
+    DEFAULT_VERSION (-1): old runs keep old behavior under new code."""
+    from cadence_tpu.worker.sdk import (
+        DEFAULT_VERSION,
+        WorkflowRegistry,
+        replay_decide,
+    )
+    from cadence_tpu.core import history_factory as F
+    from cadence_tpu.core.enums import DecisionType
+
+    # old code: just a timer
+    history = [
+        F.workflow_execution_started(
+            1, 1, 1000, workflow_type="up-wf", task_list=TL),
+        F.decision_task_scheduled(2, 1, 1000, task_list=TL),
+        F.decision_task_started(3, 1, 1001, scheduled_event_id=2),
+        F.decision_task_completed(4, 1, 1002, scheduled_event_id=2,
+                                  started_event_id=3),
+        F.timer_started(5, 1, 1002, timer_id="t1",
+                        start_to_fire_timeout_seconds=0,
+                        decision_task_completed_event_id=4),
+        F.timer_fired(6, 1, 1003, timer_id="t1", started_event_id=5),
+        F.decision_task_scheduled(7, 1, 1003, task_list=TL),
+        F.decision_task_started(8, 1, 1004, scheduled_event_id=7),
+    ]
+
+    observed = []
+
+    def new_code(ctx, input):
+        v = yield ctx.get_version("new-change", -1, 1)
+        observed.append(v)
+        yield ctx.start_timer(1)
+        return b"done"
+
+    reg = WorkflowRegistry()
+    reg.register_workflow("up-wf", new_code)
+    decisions = replay_decide(reg, history)
+    assert observed == [DEFAULT_VERSION]
+    # old history's recorded timer replays without a new StartTimer
+    # decision; the workflow completes
+    assert [d.decision_type for d in decisions] == [
+        DecisionType.CompleteWorkflowExecution
+    ]
+
+
+def test_side_effect_at_frontier_with_buffered_signal(box):
+    """A buffered-but-unread signal must not make a first-ever
+    side_effect look like a broken replay."""
+    from cadence_tpu.runtime.api import SignalRequest
+
+    def wf(ctx, input):
+        yield ctx.start_timer(1)
+        tok = yield ctx.side_effect(lambda: b"fresh")
+        payload = yield ctx.wait_signal("go")
+        return tok + b":" + payload
+
+    w = _worker(box)
+    w.register_workflow("frontier-wf", wf)
+    w.start()
+    try:
+        run = _start(box, "fr-1", "frontier-wf")
+        # signal lands while the timer pends: buffered before the read
+        box.frontend.signal_workflow_execution(
+            SignalRequest(domain=DOMAIN, workflow_id="fr-1",
+                          signal_name="go", input=b"sig")
+        )
+        _wait_closed(box, "fr-1", run)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "fr-1", run
+        )
+        assert events[-1].event_type == EventType.WorkflowExecutionCompleted
+        assert events[-1].attributes["result"] == b"fresh:sig"
+    finally:
+        w.stop()
