@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from cadence_tpu.utils.log import get_logger
+from cadence_tpu.utils.task_processor import KeyedSequentialProcessor
 
 from ..shard import ShardContext
 from .messages import HistoryTaskV2, ReplicationMessages, RetryTaskV2Error
@@ -94,6 +95,17 @@ class ReplicationTaskProcessor:
         self.max_retry = max_retry
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # per-workflow-sequential, cross-workflow-parallel fallback
+        # apply plane; created on first use, recreated after stop() so
+        # a stop/start cycle (or a post-stop synchronous drain) works
+        self._seq: Optional[KeyedSequentialProcessor] = None
+
+    def _get_seq(self) -> KeyedSequentialProcessor:
+        if self._seq is None or self._seq.is_shutdown:
+            self._seq = KeyedSequentialProcessor(
+                worker_count=4, name=f"repl-{self.shard.shard_id}"
+            )
+        return self._seq
 
     # -- synchronous drain (tests + backlog catch-up) ------------------
 
@@ -135,11 +147,59 @@ class ReplicationTaskProcessor:
                     "batched replication drain failed; falling back to "
                     "sequential apply", shard=self.shard.shard_id,
                 )
+        return self._apply_keyed(msgs.tasks)
+
+    def _apply_keyed(self, tasks) -> int:
+        """Per-task fallback: runs sequentially PER WORKFLOW (a
+        continue-as-new chain's runs must apply in order — the batched
+        path barriers on the same key), concurrently across workflows
+        (reference: replication tasks feed a keyed sequential task
+        processor, common/task/sequentialTaskProcessor.go). The cursor
+        commits through the longest finished-and-successful prefix, so
+        a failed or still-running task re-fetches while already-applied
+        peers dedup via version-history bookkeeping."""
+        failures: List[tuple] = []  # (task_id, exception)
+        flock = threading.Lock()
+
+        def run(t: HistoryTaskV2) -> None:
+            try:
+                self._process_task(t)
+            except Exception as e:
+                with flock:
+                    failures.append((t.task_id, e))
+                logger.exception(
+                    "replication task apply failed",
+                    shard=self.shard.shard_id, task_id=t.task_id,
+                    workflow=t.workflow_id,
+                )
+
+        seq = self._get_seq()
+        for task in tasks:
+            seq.submit(
+                (task.domain_id, task.workflow_id),
+                lambda t=task: run(t),
+            )
+        if not seq.flush(timeout_s=120.0):
+            # tasks still in flight: committing past them could lose
+            # them forever (the cursor only moves forward) — commit
+            # nothing; the next fetch re-applies idempotently
+            logger.error(
+                "keyed replication apply timed out with work in flight",
+                shard=self.shard.shard_id,
+            )
+            return 0
+        cutoff = min(tid for tid, _ in failures) if failures else None
         applied = 0
-        for task in msgs.tasks:
-            self._process_task(task)
+        for task in tasks:
+            if cutoff is not None and task.task_id >= cutoff:
+                break
             self.fetcher.commit(self.shard.shard_id, task.task_id)
             applied += 1
+        if applied == 0 and failures:
+            # no progress at all: surface the failure to the caller
+            # (drain()/pump) exactly like the old sequential loop did —
+            # a silent 0 would read as "stream quiescent" to failover
+            raise failures[0][1]
         return applied
 
     def drain_tasks(self, max_rounds: int = 100) -> int:
@@ -184,6 +244,11 @@ class ReplicationTaskProcessor:
                     if self.process_once() == 0:
                         self._stop.wait(interval_s)
                 except Exception:
+                    logger.exception(
+                        "replication pump cycle failed",
+                        shard=self.shard.shard_id,
+                        cluster=self.fetcher.cluster,
+                    )
                     self._stop.wait(interval_s)
 
         self._thread = threading.Thread(target=pump, daemon=True)
@@ -194,3 +259,5 @@ class ReplicationTaskProcessor:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self._seq is not None:
+            self._seq.shutdown()

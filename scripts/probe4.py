@@ -68,6 +68,11 @@ def main():
     ap.add_argument("--ablate", default="0",
                     help="comma list of kernel ablation levels for --teb "
                          "(0=full FSM .. 5=empty body)")
+    ap.add_argument("--chain", type=int, default=1,
+                    help="wrap the kernel in a lax.scan of K dependent "
+                         "iterations inside ONE jit dispatch — separates "
+                         "per-dispatch overhead (axon tunnel RTT) from "
+                         "device time")
     args = ap.parse_args()
 
     from cadence_tpu.ops import schema as S
@@ -139,12 +144,29 @@ def main():
                 rows_cat = events[valid]
                 pres = jnp.asarray(presence_masks(rows_cat, lens, T, args.bt))
             for ab in [int(a) for a in args.ablate.split(",")]:
-                f = jax.jit(lambda s, e, ab=ab: replay_scan_pallas_teb(
-                    s, e, caps, tb=args.tb, interpret=False, bt=args.bt,
-                    presence=pres, ablate=ab))
+                if args.chain > 1:
+                    from jax import lax as _lax
+
+                    def f(s, e, ab=ab):
+                        def body(c, _):
+                            return replay_scan_pallas_teb(
+                                c, e, caps, tb=args.tb, interpret=False,
+                                bt=args.bt, presence=pres, ablate=ab), None
+
+                        return _lax.scan(body, s, None,
+                                         length=args.chain)[0]
+
+                    f = jax.jit(f)
+                else:
+                    f = jax.jit(lambda s, e, ab=ab: replay_scan_pallas_teb(
+                        s, e, caps, tb=args.tb, interpret=False,
+                        bt=args.bt, presence=pres, ablate=ab))
                 try:
                     dt, v = timeit(f, state0, ev_teb, args.iters)
-                    print(f"  B={batch:6d} teb a{ab} {dt*1e3:9.2f} ms  "
+                    dt = dt / max(1, args.chain)  # per-replay
+                    tag = f"a{ab}" + (
+                        f"x{args.chain}" if args.chain > 1 else "")
+                    print(f"  B={batch:6d} teb {tag} {dt*1e3:9.2f} ms  "
                           f"{dt/T*1e6:8.2f} us/step  {batch/dt:12.0f} hist/s  "
                           f"{batch*T/dt/1e6:8.1f} Mev/s  cs={v}", flush=True)
                 except Exception as exc:

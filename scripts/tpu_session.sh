@@ -1,0 +1,23 @@
+#!/bin/sh
+# One-shot TPU measurement session: run the moment the tunnel is back.
+# Sequential (single chip, single host core). Writes /tmp/tpu_session.log.
+# 1) batch scaling        -> fixed-vs-marginal cost split
+# 2) dispatch-chain test  -> how much of the fixed cost is per-dispatch
+# 3) ablation sweep       -> where FSM compute goes
+# 4) full bench           -> honest headline + warms the compile cache
+set -x
+cd "$(dirname "$0")/.."
+
+timeout 1800 python scripts/probe4.py --config retry_deep \
+    --batches 8192,32768,131072 --teb --host-presence \
+    --bt 8192 --tb 16 --iters 5
+
+timeout 1200 python scripts/probe4.py --config retry_deep \
+    --batches 65536 --teb --host-presence --bt 8192 --tb 16 \
+    --iters 3 --chain 4
+
+timeout 2400 python scripts/probe4.py --config retry_deep \
+    --batches 65536 --teb --host-presence --bt 8192 --tb 16 \
+    --iters 5 --ablate 5,3,1,0
+
+timeout 1800 python bench.py
