@@ -118,6 +118,36 @@ def cron_tick_workflow(ctx, input):
     return b"tick"
 
 
+def sanity_workflow(ctx, input):
+    # reference canary/sanity.go: the sanity workflow fans out one
+    # child per probe workflow type and fails if any child fails
+    key = input.decode()
+    results = []
+    for i, child_type in enumerate(
+        ("canary-echo", "canary-timer", "canary-cron-tick")
+    ):
+        r = yield ctx.start_child_workflow(
+            child_type, f"sanity-{key}-{i}", input=b"s",
+            task_list=TASK_LIST,
+        )
+        results.append(r)
+    return b"sanity:" + str(len(results)).encode()
+
+
+def batch_parent_workflow(ctx, input):
+    # reference canary/batch.go: waves of children
+    key = input.decode()
+    total = 0
+    for wave in range(2):
+        for i in range(2):
+            yield ctx.start_child_workflow(
+                "canary-echo", f"batch-{key}-{wave}-{i}", input=b"b",
+                task_list=TASK_LIST,
+            )
+            total += 1
+    return b"children:" + str(total).encode()
+
+
 _flaky_counters: Dict[str, int] = {}
 
 
@@ -163,6 +193,8 @@ WORKFLOWS: Dict[str, Callable] = {
     "canary-search-attr": search_attr_workflow,
     "canary-fail-once": fail_once_workflow,
     "canary-cron-tick": cron_tick_workflow,
+    "canary-sanity": sanity_workflow,
+    "canary-batch-parent": batch_parent_workflow,
 }
 
 LOCAL_ACTIVITIES: Dict[str, Callable] = {
@@ -425,6 +457,114 @@ def probe_cron(fe, domain) -> None:
             pass  # the chain may be between runs
 
 
+def probe_sanity(fe, domain) -> None:
+    key = uuid.uuid4().hex[:8]
+    wf = f"canary-sanity-{key}"
+    run = _start(fe, domain, "canary-sanity", wf, key.encode())
+    assert _wait_result(fe, domain, wf, run, timeout_s=30.0) == b"sanity:3"
+
+
+def probe_batch_children(fe, domain) -> None:
+    key = uuid.uuid4().hex[:8]
+    wf = f"canary-batch-{key}"
+    run = _start(fe, domain, "canary-batch-parent", wf, key.encode())
+    assert _wait_result(fe, domain, wf, run, timeout_s=30.0) == b"children:4"
+
+
+def probe_batch_operation(fe, domain) -> None:
+    """Bulk terminate through the batcher system workflow
+    (service/worker/batcher; canary batch coverage of the service)."""
+    import json
+
+    from cadence_tpu.worker.archiver import SYSTEM_DOMAIN
+    from cadence_tpu.worker.batcher import (
+        BATCHER_TASK_LIST,
+        BATCHER_WORKFLOW_TYPE,
+    )
+
+    key = uuid.uuid4().hex[:8]
+    victims = [f"canary-bt-{key}-{i}" for i in range(3)]
+    runs = {
+        wf: _start(fe, domain, "canary-sleeper", wf) for wf in victims
+    }
+    batch_wf = f"canary-batch-op-{key}"
+    payload = json.dumps({
+        "operation": "terminate",
+        "domain": domain,
+        "executions": [{"workflow_id": wf} for wf in victims],
+        "params": {"reason": "canary batch"},
+    }).encode()
+    fe.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=SYSTEM_DOMAIN, workflow_id=batch_wf,
+            workflow_type=BATCHER_WORKFLOW_TYPE,
+            task_list=BATCHER_TASK_LIST, input=payload,
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    for wf in victims:
+        last = _wait_close(fe, domain, wf, runs[wf], timeout_s=30.0)
+        assert last.event_type == EventType.WorkflowExecutionTerminated, (
+            wf, last.event_type,
+        )
+
+
+def probe_archival(fe, domain) -> None:
+    """Close → archived history in the filestore (host/archival_test.go
+    shape). Uses ONE idempotently-registered archival-enabled domain —
+    a periodic canary must not leak a domain per run — and closes the
+    workflow by terminate so no worker is involved."""
+    import os
+    import tempfile
+
+    from cadence_tpu.archival import ArchiverProvider, URI
+    from cadence_tpu.frontend.domain_handler import (
+        ArchivalStatus,
+        DomainAlreadyExistsError,
+    )
+
+    tmp = os.path.join(tempfile.gettempdir(), "canary-archival")
+    adomain = "canary-archival"
+    try:
+        fe.register_domain(
+            adomain, retention_days=1,
+            history_archival_status=ArchivalStatus.ENABLED,
+            history_archival_uri=f"file://{tmp}/h",
+            visibility_archival_status=ArchivalStatus.ENABLED,
+            visibility_archival_uri=f"file://{tmp}/v",
+        )
+    except DomainAlreadyExistsError:
+        pass
+    key = uuid.uuid4().hex[:8]
+    wf = f"canary-arch-wf-{key}"
+    run = fe.start_workflow_execution(
+        StartWorkflowRequest(
+            domain=adomain, workflow_id=wf, workflow_type="canary-echo",
+            task_list=TASK_LIST,
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    fe.terminate_workflow_execution(adomain, wf, run, reason="archive me")
+
+    archiver = ArchiverProvider.default().get_history_archiver("file")
+    uri = URI.parse(f"file://{tmp}/h")
+    domain_id = fe.describe_domain(name=adomain).info.id
+    deadline = time.monotonic() + 20.0
+    batches = None
+    while time.monotonic() < deadline:
+        try:
+            batches, _ = archiver.get(uri, domain_id, wf, run)
+        except FileNotFoundError:
+            batches = None  # not archived yet
+        if batches:
+            break
+        time.sleep(0.2)
+    assert batches, "history never reached the archive store"
+    events = [e for b in batches for e in b]
+    assert events[0].event_type == EventType.WorkflowExecutionStarted
+    assert events[-1].event_type == EventType.WorkflowExecutionTerminated
+
+
 PROBES: Dict[str, Callable] = {
     "echo": probe_echo,
     "signal": probe_signal,
@@ -442,4 +582,8 @@ PROBES: Dict[str, Callable] = {
     "search_attributes": probe_search_attributes,
     "workflow_retry": probe_workflow_retry,
     "cron": probe_cron,
+    "sanity": probe_sanity,
+    "batch": probe_batch_children,
+    "batch_operation": probe_batch_operation,
+    "archival": probe_archival,
 }

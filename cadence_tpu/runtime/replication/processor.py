@@ -181,24 +181,28 @@ class ReplicationTaskProcessor:
             )
         if not seq.flush(timeout_s=120.0):
             # tasks still in flight: committing past them could lose
-            # them forever (the cursor only moves forward) — commit
-            # nothing; the next fetch re-applies idempotently
-            logger.error(
-                "keyed replication apply timed out with work in flight",
-                shard=self.shard.shard_id,
+            # them forever (the cursor only moves forward). Raise —
+            # returning 0 would read as "stream quiescent" to a
+            # failover drain while work is still outstanding
+            raise TimeoutError(
+                f"shard {self.shard.shard_id}: keyed replication apply "
+                "timed out with work in flight"
             )
-            return 0
         cutoff = min(tid for tid, _ in failures) if failures else None
         applied = 0
+        last_ok = None
         for task in tasks:
             if cutoff is not None and task.task_id >= cutoff:
                 break
-            self.fetcher.commit(self.shard.shard_id, task.task_id)
+            last_ok = task.task_id
             applied += 1
+        if last_ok is not None:
+            # the cursor is a monotonic watermark: one commit covers
+            # the whole successful prefix
+            self.fetcher.commit(self.shard.shard_id, last_ok)
         if applied == 0 and failures:
             # no progress at all: surface the failure to the caller
-            # (drain()/pump) exactly like the old sequential loop did —
-            # a silent 0 would read as "stream quiescent" to failover
+            # (drain()/pump) exactly like the old sequential loop did
             raise failures[0][1]
         return applied
 
@@ -260,4 +264,7 @@ class ReplicationTaskProcessor:
             self._thread.join(timeout=2.0)
             self._thread = None
         if self._seq is not None:
-            self._seq.shutdown()
+            # wait=False: a hung apply must not turn the bounded stop()
+            # into an indefinite block (the pool threads are abandoned;
+            # the interpreter reaps them at exit)
+            self._seq.shutdown(wait=False)

@@ -52,7 +52,15 @@ class KeyedSequentialProcessor:
                 q.append(fn)
                 return
             self._queues[key] = deque([fn])
-        self._pool.submit(self._drain_key, key)
+            # under the lock: keeps the shutdown check and the pool
+            # submission atomic vs shutdown() (pool.submit never blocks
+            # on task execution, so holding the lock here is safe)
+            try:
+                self._pool.submit(self._drain_key, key)
+            except BaseException:
+                self._pending -= 1
+                del self._queues[key]
+                raise
 
     def _drain_key(self, key: Hashable) -> None:
         while True:

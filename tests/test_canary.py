@@ -13,5 +13,6 @@ def test_all_probes_pass():
         "echo", "signal", "timer", "retry", "concurrent", "query",
         "visibility", "reset", "timeout", "cancellation",
         "cancellation_external", "signal_external", "local_activity",
-        "search_attributes", "workflow_retry", "cron",
+        "search_attributes", "workflow_retry", "cron", "sanity",
+        "batch", "batch_operation", "archival",
     }
