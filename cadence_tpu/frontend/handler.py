@@ -67,6 +67,9 @@ class WorkflowHandler:
             instrument_methods,
         )
 
+        from cadence_tpu.utils.log import get_logger
+
+        self._log = get_logger("cadence_tpu.frontend")
         self.metrics = (metrics or NOOP).tagged(service="frontend")
         instrument_methods(self, self.metrics, FRONTEND_OPS)
 
@@ -234,11 +237,69 @@ class WorkflowHandler:
     ):
         self._check(domain, **headers)
         self._check_id(workflow_id, "workflowId")
-        return self.history.get_workflow_execution_history(
-            domain, workflow_id, run_id,
-            first_event_id=first_event_id, page_size=page_size,
-            next_token=next_token, wait_for_new_event=wait_for_new_event,
-        )
+        try:
+            return self.history.get_workflow_execution_history(
+                domain, workflow_id, run_id,
+                first_event_id=first_event_id, page_size=page_size,
+                next_token=next_token,
+                wait_for_new_event=wait_for_new_event,
+            )
+        except EntityNotExistsServiceError:
+            # retention already deleted the run: serve the archive
+            # (reference workflowHandler.getArchivedHistory fallback)
+            archived = self._archived_history(
+                domain, workflow_id, run_id,
+                first_event_id=first_event_id, page_size=page_size,
+                next_token=next_token,
+            )
+            if archived is None:
+                raise
+            return archived
+
+    def _archived_history(self, domain: str, workflow_id: str,
+                          run_id: str, first_event_id: int = 1,
+                          page_size: int = 0, next_token: int = 0):
+        from cadence_tpu.archival import URI
+        from cadence_tpu.frontend.domain_handler import ArchivalStatus
+
+        if not run_id:
+            return None  # the archive is keyed by concrete run
+        rec = self.domains.get_by_name(domain)
+        cfg = rec.config
+        if (
+            cfg.history_archival_status != ArchivalStatus.ENABLED
+            or not cfg.history_archival_uri
+        ):
+            return None
+        try:
+            uri = URI.parse(cfg.history_archival_uri)
+            archiver = self._archival_provider().get_history_archiver(
+                uri.scheme
+            )
+            batches, token = archiver.get(
+                uri, rec.info.id, workflow_id, run_id,
+                page_size=page_size, next_token=next_token,
+            )
+        except FileNotFoundError:
+            return None
+        except Exception:
+            # a broken archival config must not turn NOT_FOUND into an
+            # internal error — the caller re-raises the original
+            self._log.exception(
+                f"archived-history read failed for {domain}/{workflow_id}"
+            )
+            return None
+        events = [e for b in batches for e in b]
+        if first_event_id > 1:
+            events = [e for e in events if e.event_id >= first_event_id]
+        return events, token
+
+    def _archival_provider(self):
+        if getattr(self, "_arch_provider", None) is None:
+            from cadence_tpu.archival import ArchiverProvider
+
+            self._arch_provider = ArchiverProvider.default()
+        return self._arch_provider
 
     # -- worker APIs ---------------------------------------------------
 
@@ -421,6 +482,39 @@ class WorkflowHandler:
     ):
         return self.list_workflow_executions(
             domain, query, page_size, next_token, **headers
+        )
+
+    def health(self) -> dict:
+        """Liveness probe (reference workflowHandler.Health)."""
+        return {"ok": True, "service": "frontend"}
+
+    def list_archived_workflow_executions(
+        self, domain: str, query: str = "", page_size: int = 100,
+        next_token: int = 0, **headers,
+    ):
+        """Query the domain's visibility archive (reference
+        workflowHandler.ListArchivedWorkflowExecutions — serves records
+        whose retention already deleted them from live visibility)."""
+        from cadence_tpu.archival import URI
+        from cadence_tpu.frontend.domain_handler import ArchivalStatus
+
+        self._check(domain, **headers)
+        rec = self.domains.get_by_name(domain)
+        cfg = rec.config
+        if (
+            cfg.visibility_archival_status != ArchivalStatus.ENABLED
+            or not cfg.visibility_archival_uri
+        ):
+            raise BadRequestError(
+                f"domain {domain} has no visibility archival enabled"
+            )
+        uri = URI.parse(cfg.visibility_archival_uri)
+        archiver = self._archival_provider().get_visibility_archiver(
+            uri.scheme
+        )
+        return archiver.query(
+            uri, rec.info.id, query,
+            page_size=page_size, next_token=next_token,
         )
 
     def count_workflow_executions(

@@ -38,6 +38,7 @@ FRONTEND_OPS = (
     "list_open_workflow_executions", "list_closed_workflow_executions",
     "list_workflow_executions", "scan_workflow_executions",
     "count_workflow_executions", "get_search_attributes",
+    "list_archived_workflow_executions", "health",
 )
 
 HISTORY_OPS = (
