@@ -54,6 +54,17 @@ class AdminHandler:
             domain_id, workflow_id, run_id, start_event_id, end_event_id
         )
 
+    def refresh_workflow_tasks(
+        self, domain_name: str, workflow_id: str, run_id: str = ""
+    ) -> Dict[str, Any]:
+        """Regenerate a run's queue tasks from state (reference
+        adminHandler.RefreshWorkflowTasks) — pairs with remove_task for
+        recovering from a poisoned or lost task."""
+        domain_id = self.domains.get_by_name(domain_name).info.id
+        engine = self.history.controller.get_engine(workflow_id)
+        n = engine.refresh_workflow_tasks(domain_id, workflow_id, run_id)
+        return {"tasks_generated": n}
+
     def describe_workflow_execution(
         self, domain_name: str, workflow_id: str, run_id: str = ""
     ) -> Dict[str, Any]:

@@ -264,6 +264,12 @@ class WorkflowHandler:
 
         if not run_id:
             return None  # the archive is keyed by concrete run
+        if next_token:
+            # live and archive tokens are different coordinate systems
+            # (event id vs batch index) — a pagination that started on
+            # the live store cannot resume against the archive; the
+            # client re-reads from the start and pages the archive
+            return None
         rec = self.domains.get_by_name(domain)
         cfg = rec.config
         if (

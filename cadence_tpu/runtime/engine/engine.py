@@ -761,6 +761,29 @@ class HistoryEngine:
         retries (read-only callers just return values)."""
         return self._update_workflow(domain_id, workflow_id, run_id, fn)
 
+    def refresh_workflow_tasks(
+        self, domain_id: str, workflow_id: str, run_id: str = ""
+    ) -> int:
+        """Regenerate this run's transfer/timer tasks from its current
+        mutable state (reference adminHandler.RefreshWorkflowTasks →
+        mutableStateTaskRefresher) — the operator fix for a run whose
+        tasks were lost or surgically removed. Returns the task count."""
+        from cadence_tpu.core.task_refresher import refresh_tasks
+
+        def action(ctx, ms):
+            transfer, timer = refresh_tasks(ms)
+            txn = self._txn(ctx, ms, ms.current_version)
+            for t in transfer:
+                txn.schedule_transfer_task(t)
+            for t in timer:
+                txn.schedule_timer_task(t)
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+            return len(transfer) + len(timer)
+
+        return self._update_workflow(domain_id, workflow_id, run_id, action)
+
     # -- cross-workflow callbacks (invoked by the transfer queue) ------
     # Reference: transferQueueActiveProcessor.go record*Completed/Failed
     # helpers and historyEngine.RecordChildExecutionCompleted — each

@@ -373,6 +373,10 @@ def cmd_admin(args) -> None:
         _print(fe.describe_workflow_execution(
             args.domain, args.workflow_id, args.run_id or ""
         ))
+    elif args.admin_cmd == "refresh-tasks":
+        _print(fe.refresh_workflow_tasks(
+            args.domain, args.workflow_id, args.run_id or ""
+        ))
 
 
 def cmd_batch(args) -> None:
@@ -499,10 +503,11 @@ def build_parser() -> argparse.ArgumentParser:
     asub.add_parser("describe-host")
     acs = asub.add_parser("close-shard")
     acs.add_argument("--shard-id", type=int, required=True)
-    adw = asub.add_parser("describe-workflow")
-    adw.add_argument("--domain", required=True)
-    adw.add_argument("--workflow-id", required=True)
-    adw.add_argument("--run-id", default="")
+    for name in ("describe-workflow", "refresh-tasks"):
+        adw = asub.add_parser(name)
+        adw.add_argument("--domain", required=True)
+        adw.add_argument("--workflow-id", required=True)
+        adw.add_argument("--run-id", default="")
     a.set_defaults(fn=cmd_admin)
 
     b = sub.add_parser("batch")

@@ -422,3 +422,27 @@ class TestPersistenceDecorators:
         with pytest.raises(PersistenceBusyError):
             for _ in range(50):
                 throttled.metadata.list_domains()
+
+
+def test_admin_refresh_workflow_tasks(fb):
+    """remove_task + refresh_workflow_tasks: the operator recovery pair
+    (reference adminHandler RemoveTask/RefreshWorkflowTasks)."""
+    from cadence_tpu.runtime.api import StartWorkflowRequest
+
+    run_id = fb.frontend.start_workflow_execution(
+        StartWorkflowRequest(
+            domain="fe-domain", workflow_id="adm-refresh",
+            workflow_type="t",
+            task_list="adm-tl",
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    out = fb.admin.refresh_workflow_tasks("fe-domain", "adm-refresh",
+                                          run_id)
+    assert out["tasks_generated"] >= 1  # pending decision regenerates
+    # the refreshed decision task is dispatchable (dup dispatch of the
+    # same schedule id is absorbed by matching/engine dedup)
+    task = fb.frontend.poll_for_decision_task(
+        "fe-domain", "adm-tl", identity="adm", timeout_s=5.0
+    )
+    assert task is not None
