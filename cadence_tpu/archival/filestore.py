@@ -102,7 +102,9 @@ class FilestoreHistoryArchiver(HistoryArchiver):
             page = batches[next_token : next_token + page_size]
             token = next_token + len(page)
             return page, (token if token < len(batches) else 0)
-        return batches, 0
+        # unpaged read still honors a resume token — a client may page
+        # the first call and fetch the remainder with page_size=0
+        return batches[next_token:], 0
 
 
 class FilestoreVisibilityArchiver(VisibilityArchiver):

@@ -535,12 +535,33 @@ class MutableState:
         self._update_decision(d)
         return d
 
+    # reference: dynamicconfig MaxAutoResetPoints (default 20)
+    MAX_RESET_POINTS = 20
+
     def replicate_decision_task_completed_event(self, event: HistoryEvent) -> None:
         # reference: mutableStateDecisionTaskManager.go:255-262,789-800
         self.delete_decision()
         self.execution_info.last_processed_event = event.attributes.get(
             "started_event_id", EMPTY_EVENT_ID
         )
+        # auto reset points (reference addBinaryCheckSumIfNotExists,
+        # called from the replicate path so active, replicated, and
+        # rebuilt state all agree): the first completed decision per
+        # worker binary is a safe reset anchor for bad-binary recovery
+        checksum = event.attributes.get("binary_checksum", "") or ""
+        ei = self.execution_info
+        if checksum and all(
+            p.get("binary_checksum") != checksum
+            for p in ei.auto_reset_points
+        ):
+            ei.auto_reset_points.append({
+                "binary_checksum": checksum,
+                "run_id": ei.run_id,
+                "first_decision_completed_id": event.event_id,
+                "created_time": event.timestamp,
+                "resettable": True,
+            })
+            del ei.auto_reset_points[:-self.MAX_RESET_POINTS]
 
     def replicate_decision_task_failed_event(self, now: int = 0) -> None:
         # reference: mutableStateDecisionTaskManager.go:264-267

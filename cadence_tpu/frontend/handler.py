@@ -237,6 +237,21 @@ class WorkflowHandler:
     ):
         self._check(domain, **headers)
         self._check_id(workflow_id, "workflowId")
+        if next_token < 0:
+            # a token this handler issued from the archive (negative
+            # tag distinguishes it from live event-id tokens): resume
+            # the archive read directly
+            archived = self._archived_history(
+                domain, workflow_id, run_id,
+                first_event_id=first_event_id, page_size=page_size,
+                next_token=-next_token,
+            )
+            if archived is None:
+                raise EntityNotExistsServiceError(
+                    f"archived history for {workflow_id}/{run_id} "
+                    "is gone"
+                )
+            return archived
         try:
             return self.history.get_workflow_execution_history(
                 domain, workflow_id, run_id,
@@ -246,11 +261,14 @@ class WorkflowHandler:
             )
         except EntityNotExistsServiceError:
             # retention already deleted the run: serve the archive
-            # (reference workflowHandler.getArchivedHistory fallback)
+            # (reference workflowHandler.getArchivedHistory fallback).
+            # Only a fresh read falls back — a live-issued token is an
+            # event id, meaningless as an archive batch index
+            if next_token:
+                raise
             archived = self._archived_history(
                 domain, workflow_id, run_id,
                 first_event_id=first_event_id, page_size=page_size,
-                next_token=next_token,
             )
             if archived is None:
                 raise
@@ -264,12 +282,6 @@ class WorkflowHandler:
 
         if not run_id:
             return None  # the archive is keyed by concrete run
-        if next_token:
-            # live and archive tokens are different coordinate systems
-            # (event id vs batch index) — a pagination that started on
-            # the live store cannot resume against the archive; the
-            # client re-reads from the start and pages the archive
-            return None
         rec = self.domains.get_by_name(domain)
         cfg = rec.config
         if (
@@ -298,7 +310,9 @@ class WorkflowHandler:
         events = [e for b in batches for e in b]
         if first_event_id > 1:
             events = [e for e in events if e.event_id >= first_event_id]
-        return events, token
+        # tag archive continuation tokens negative so the next request
+        # routes back here instead of the live store
+        return events, (-token if token else 0)
 
     def _archival_provider(self):
         if getattr(self, "_arch_provider", None) is None:

@@ -34,6 +34,7 @@ import numpy as np
 from cadence_tpu.core.enums import EventType, TimeoutType
 from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.core.ids import EMPTY_EVENT_ID
+from cadence_tpu.core.mutable_state import MutableState
 from cadence_tpu.utils.hashing import hash31
 
 from . import schema as S
@@ -67,6 +68,10 @@ class WorkflowSideTable:
     memo: Dict[str, bytes] = dataclasses.field(default_factory=dict)
     search_attributes: Dict[str, bytes] = dataclasses.field(default_factory=dict)
     continued_execution_run_id: str = ""
+    # auto reset points (first completed decision per worker binary) —
+    # derived here at pack time so device rebuilds agree with the host
+    # oracle's replicate path (mutable_state MAX_RESET_POINTS cap)
+    auto_reset_points: List[Dict] = dataclasses.field(default_factory=list)
     # slot → strings
     activity_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
     activity_task_lists: Dict[int, str] = dataclasses.field(default_factory=dict)
@@ -301,6 +306,21 @@ def pack_workflow(
             elif et == EventType.DecisionTaskCompleted:
                 attrs[0] = a.get("started_event_id", EMPTY_EVENT_ID)
                 pending_dec = None
+                checksum = a.get("binary_checksum", "") or ""
+                if checksum and all(
+                    p["binary_checksum"] != checksum
+                    for p in side.auto_reset_points
+                ):
+                    side.auto_reset_points.append({
+                        "binary_checksum": checksum,
+                        "run_id": side.run_id,
+                        "first_decision_completed_id": ev.event_id,
+                        "created_time": ev.timestamp,
+                        "resettable": True,
+                    })
+                    del side.auto_reset_points[
+                        : -MutableState.MAX_RESET_POINTS
+                    ]
 
             elif et == EventType.DecisionTaskTimedOut:
                 attrs[0] = a.get("timeout_type", 0)

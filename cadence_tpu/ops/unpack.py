@@ -280,6 +280,7 @@ def state_row_to_mutable_state(
     ei.parent_run_id = side.parent_run_id
     ei.memo = dict(side.memo)
     ei.search_attributes = dict(side.search_attributes)
+    ei.auto_reset_points = [dict(p) for p in side.auto_reset_points]
     ei.state = WorkflowState(int(ex[S.X_STATE]))
     ei.close_status = CloseStatus(int(ex[S.X_CLOSE_STATUS]))
     ei.next_event_id = int(ex[S.X_NEXT_EVENT_ID])

@@ -19,6 +19,7 @@ from cadence_tpu.core.active_transaction import (
 )
 from cadence_tpu.core.enums import (
     CloseStatus,
+    DecisionTaskFailedCause,
     EventType,
     IDReusePolicy,
     TimeoutType,
@@ -509,12 +510,29 @@ class HistoryEngine:
             started_id = ei.decision_started_id
             version = ms.current_version
             now = self.shard.now()
+            # bad-binary gate (reference handleDecisionTaskCompleted →
+            # checkBadBinary): a worker running a checksum the domain
+            # marked bad must not make progress
+            if binary_checksum and binary_checksum in (
+                self.domains.get_by_id(domain_id).config.bad_binaries
+            ):
+                self._fail_decision_task(
+                    ctx, schedule_id,
+                    int(DecisionTaskFailedCause.BadBinary),
+                    f"binary {binary_checksum!r} is marked bad for "
+                    "this domain",
+                    identity,
+                )
+                return
             txn = self._txn(ctx, ms, version)
             had_buffered = txn.has_buffered_events()
             completed = txn.add_decision_task_completed(
                 schedule_id, started_id, now,
                 identity=identity, binary_checksum=binary_checksum,
             )
+            # reset points record in the shared StateBuilder replicate
+            # path (mutable_state.replicate_decision_task_completed_
+            # event) so active, replicated, and rebuilt state agree
             # stickiness (reference: handleDecisionTaskCompleted)
             if sticky_task_list:
                 ei.sticky_task_list = sticky_task_list

@@ -32,10 +32,21 @@ def delete_workflow_retention(shard, engine, task) -> None:
         shard.shard_id, task.domain_id, task.workflow_id, task.run_id
     )
     if branch and hist is not None:
+        from cadence_tpu.runtime.persistence.records import BranchToken
+        from cadence_tpu.utils.log import get_logger
+
+        if isinstance(branch, bytes):
+            branch = branch.decode()
         try:
-            hist.delete_history_branch(branch)
+            hist.delete_history_branch(BranchToken.from_json(branch))
         except Exception:
-            pass
+            # the execution record is already gone, so this branch will
+            # never be retried — make the leak visible instead of
+            # silently recreating the swallowed-error bug
+            get_logger("cadence_tpu.retention").exception(
+                f"history branch delete failed for {task.workflow_id}/"
+                f"{task.run_id}; branch leaked"
+            )
     engine.cache.evict(task.domain_id, task.workflow_id, task.run_id)
     events_cache = getattr(engine, "events_cache", None)
     if events_cache is not None:

@@ -59,6 +59,24 @@ def _close_and_archive(box, wf_id: str) -> str:
     raise AssertionError("visibility record never reached the archive")
 
 
+def _retention_delete(box, shard_id, domain_id, wf_id, run_id):
+    """Exactly what the retention timer does (queues/retention.py):
+    visibility + execution + history branch + cache eviction."""
+    from cadence_tpu.runtime.queues.retention import (
+        delete_workflow_retention,
+    )
+
+    class _Task:
+        pass
+
+    task = _Task()
+    task.domain_id, task.workflow_id, task.run_id = (
+        domain_id, wf_id, run_id,
+    )
+    engine = box.history.controller.get_engine_for_shard(shard_id)
+    delete_workflow_retention(engine.shard, engine, task)
+
+
 def test_archived_visibility_listing(box):
     run = _close_and_archive(box, "av-1")
     recs, _ = box.frontend.list_archived_workflow_executions(
@@ -94,12 +112,7 @@ def test_history_falls_back_to_archive_after_retention_delete(box):
         raise AssertionError("history never archived")
 
     shard_id = shard_for_workflow("ah-1", 2)
-    box.persistence.execution.delete_workflow_execution(
-        shard_id, domain_id, "ah-1", run
-    )
-    box.persistence.execution.delete_current_workflow_execution(
-        shard_id, domain_id, "ah-1", run
-    )
+    _retention_delete(box, shard_id, domain_id, "ah-1", run)
     # the live path now 404s; the frontend serves the archive instead
     events, _ = box.frontend.get_workflow_execution_history(
         DOMAIN, "ah-1", run
@@ -114,3 +127,67 @@ def test_archived_listing_requires_enabled_domain(box):
         box.frontend.list_archived_workflow_executions(
             "no-arch-dom", ""
         )
+
+
+def test_archive_pagination_round_trip(box):
+    """Archive continuation tokens (negative-tagged) page the archive;
+    a live-issued token never aliases into it."""
+    run = _close_and_archive(box, "ap-1")
+
+    # wait for the history blob, then delete the live run (retention)
+    from cadence_tpu.archival import ArchiverProvider, URI
+
+    domain_id = box.domains.get_by_name(DOMAIN).info.id
+    uri = URI.parse(
+        box.domains.get_by_name(DOMAIN).config.history_archival_uri
+    )
+    archiver = ArchiverProvider.default().get_history_archiver("file")
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        try:
+            archiver.get(uri, domain_id, "ap-1", run)
+            break
+        except FileNotFoundError:
+            time.sleep(0.2)
+    shard_id = shard_for_workflow("ap-1", 2)
+    _retention_delete(box, shard_id, domain_id, "ap-1", run)
+
+    # page through the archive one batch at a time
+    all_events = []
+    token = 0
+    for _ in range(20):
+        events, token = box.frontend.get_workflow_execution_history(
+            DOMAIN, "ap-1", run, page_size=1, next_token=token
+        )
+        all_events.extend(events)
+        if not token:
+            break
+        assert token < 0, "archive token must be negative-tagged"
+    assert all_events[0].event_type == EventType.WorkflowExecutionStarted
+    assert all_events[-1].event_type == (
+        EventType.WorkflowExecutionTerminated
+    )
+    ids = [e.event_id for e in all_events]
+    assert ids == sorted(set(ids)), "pagination duplicated/lost events"
+
+
+def test_retention_actually_deletes_history_branch(box):
+    """Regression: retention passed a raw token where the store wants a
+    BranchToken — the swallowed error silently leaked every branch."""
+    from cadence_tpu.runtime.persistence.records import BranchToken
+
+    run = _close_and_archive(box, "rb-1")
+    domain_id = box.domains.get_by_name(DOMAIN).info.id
+    shard_id = shard_for_workflow("rb-1", 2)
+    snap = box.persistence.execution.get_workflow_execution(
+        shard_id, domain_id, "rb-1", run
+    ).snapshot
+    token = snap["execution_info"]["branch_token"]
+    token = token.decode() if isinstance(token, bytes) else token
+    branch = BranchToken.from_json(token)
+    batches, _ = box.persistence.history.read_history_branch(branch, 1, 99)
+    assert batches, "sanity: branch has events before retention"
+
+    _retention_delete(box, shard_id, domain_id, "rb-1", run)
+    batches, _ = box.persistence.history.read_history_branch(branch, 1, 99)
+    assert batches == [], "retention left the history branch behind"

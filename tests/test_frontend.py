@@ -446,3 +446,51 @@ def test_admin_refresh_workflow_tasks(fb):
         "fe-domain", "adm-tl", identity="adm", timeout_s=5.0
     )
     assert task is not None
+
+
+def test_bad_binary_rejected_and_reset_points_recorded(fb):
+    """checkBadBinary + addResetPointFromCompletion (reference
+    handleDecisionTaskCompleted)."""
+    from cadence_tpu.core.enums import DecisionType
+    from cadence_tpu.runtime.api import Decision, StartWorkflowRequest
+
+    fb.frontend.start_workflow_execution(
+        StartWorkflowRequest(
+            domain="fe-domain", workflow_id="bb-wf", workflow_type="t",
+            task_list="bb-tl",
+            execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    task = fb.frontend.poll_for_decision_task(
+        "fe-domain", "bb-tl", identity="w", timeout_s=5.0
+    )
+    assert task is not None
+    # mark the worker's binary bad BEFORE it responds
+    fb.domain_handler.update_domain(
+        "fe-domain",
+        add_bad_binary={"checksum": "sha-bad", "reason": "rollback"},
+    )
+    fb.frontend.respond_decision_task_completed(
+        task.task_token, [], binary_checksum="sha-bad",
+    )
+    # the completion was rejected: the decision re-schedules and a
+    # GOOD binary can complete it
+    task2 = fb.frontend.poll_for_decision_task(
+        "fe-domain", "bb-tl", identity="w", timeout_s=5.0
+    )
+    assert task2 is not None
+    from cadence_tpu.core.enums import EventType as ET
+
+    assert any(
+        e.event_type == ET.DecisionTaskFailed for e in task2.history
+    ), "bad-binary completion was not failed"
+    fb.frontend.respond_decision_task_completed(
+        task2.task_token,
+        [Decision(DecisionType.CompleteWorkflowExecution,
+                  {"result": b"ok"})],
+        binary_checksum="sha-good",
+    )
+    desc = fb.admin.describe_workflow_execution("fe-domain", "bb-wf")
+    snap = desc["mutable_state"] or {}
+    points = snap.get("execution_info", {}).get("auto_reset_points", [])
+    assert [p["binary_checksum"] for p in points] == ["sha-good"]
