@@ -538,30 +538,42 @@ class MutableState:
     # reference: dynamicconfig MaxAutoResetPoints (default 20)
     MAX_RESET_POINTS = 20
 
+    @staticmethod
+    def record_reset_point(
+        points: List[Dict[str, Any]], checksum: str, run_id: str,
+        completed_event_id: int, created_time: int,
+    ) -> None:
+        """Append the first-completed-decision-per-binary reset anchor
+        (reference addBinaryCheckSumIfNotExists) with dedup + cap. The
+        ONE implementation shared by the live replicate path and the
+        device packer (ops/pack.py) so rebuilt state always agrees."""
+        if not checksum or any(
+            p.get("binary_checksum") == checksum for p in points
+        ):
+            return
+        points.append({
+            "binary_checksum": checksum,
+            "run_id": run_id,
+            "first_decision_completed_id": completed_event_id,
+            "created_time": created_time,
+            "resettable": True,
+        })
+        del points[:-MutableState.MAX_RESET_POINTS]
+
     def replicate_decision_task_completed_event(self, event: HistoryEvent) -> None:
         # reference: mutableStateDecisionTaskManager.go:255-262,789-800
         self.delete_decision()
         self.execution_info.last_processed_event = event.attributes.get(
             "started_event_id", EMPTY_EVENT_ID
         )
-        # auto reset points (reference addBinaryCheckSumIfNotExists,
-        # called from the replicate path so active, replicated, and
-        # rebuilt state all agree): the first completed decision per
-        # worker binary is a safe reset anchor for bad-binary recovery
-        checksum = event.attributes.get("binary_checksum", "") or ""
+        # auto reset points live on the replicate path so active,
+        # replicated, and rebuilt state all agree
         ei = self.execution_info
-        if checksum and all(
-            p.get("binary_checksum") != checksum
-            for p in ei.auto_reset_points
-        ):
-            ei.auto_reset_points.append({
-                "binary_checksum": checksum,
-                "run_id": ei.run_id,
-                "first_decision_completed_id": event.event_id,
-                "created_time": event.timestamp,
-                "resettable": True,
-            })
-            del ei.auto_reset_points[:-self.MAX_RESET_POINTS]
+        self.record_reset_point(
+            ei.auto_reset_points,
+            event.attributes.get("binary_checksum", "") or "",
+            ei.run_id, event.event_id, event.timestamp,
+        )
 
     def replicate_decision_task_failed_event(self, now: int = 0) -> None:
         # reference: mutableStateDecisionTaskManager.go:264-267

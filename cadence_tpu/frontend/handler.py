@@ -196,16 +196,80 @@ class WorkflowHandler:
     def reset_workflow_execution(
         self, domain: str, workflow_id: str, run_id: str = "",
         reason: str = "", decision_finish_event_id: int = 0,
-        request_id: str = "", **headers,
+        request_id: str = "", reset_type: str = "",
+        bad_binary_checksum: str = "", **headers,
     ) -> str:
+        """Reset at a decision boundary. Either an explicit
+        ``decision_finish_event_id`` or a ``reset_type`` the handler
+        resolves (reference tools/cli resetTypes):
+
+          FirstDecisionCompleted | LastDecisionCompleted |
+          BadBinary (with bad_binary_checksum: the event BEFORE that
+          binary's first completed decision, i.e. undo its work)
+        """
         self._check(domain, **headers)
         self._check_id(workflow_id, "workflowId")
+        if not decision_finish_event_id:
+            if not run_id:
+                # pin the concrete run NOW: resolving the reset point
+                # against one run and resetting "the current run" later
+                # races continue-as-new
+                run_id = self.history.describe_workflow_execution(
+                    domain, workflow_id
+                ).run_id
+            decision_finish_event_id = self._resolve_reset_point(
+                domain, workflow_id, run_id, reset_type,
+                bad_binary_checksum,
+            )
         return self.history.reset_workflow_execution(
             domain, workflow_id, run_id,
             reason=reason,
             decision_finish_event_id=decision_finish_event_id,
             request_id=request_id,
         )
+
+    def _resolve_reset_point(
+        self, domain: str, workflow_id: str, run_id: str,
+        reset_type: str, bad_binary_checksum: str,
+    ) -> int:
+        if not reset_type:
+            raise BadRequestError(
+                "either decisionFinishEventId or resetType is required"
+            )
+        events, _ = self.history.get_workflow_execution_history(
+            domain, workflow_id, run_id
+        )
+        completed = [
+            e for e in events
+            if e.event_type == EventType.DecisionTaskCompleted
+        ]
+        if reset_type == "FirstDecisionCompleted":
+            if not completed:
+                raise BadRequestError("run has no completed decision")
+            return completed[0].event_id
+        if reset_type == "LastDecisionCompleted":
+            if not completed:
+                raise BadRequestError("run has no completed decision")
+            return completed[-1].event_id
+        if reset_type == "BadBinary":
+            if not bad_binary_checksum:
+                raise BadRequestError(
+                    "BadBinary reset needs badBinaryChecksum"
+                )
+            # fork AT the bad binary's first completed decision: the
+            # cut keeps everything before it and re-drives that
+            # decision on a good binary (reference resetter uses the
+            # reset point's FirstDecisionCompletedId)
+            for e in completed:
+                if e.attributes.get(
+                    "binary_checksum", ""
+                ) == bad_binary_checksum:
+                    return e.event_id
+            raise BadRequestError(
+                f"binary {bad_binary_checksum!r} completed no decision "
+                "in this run"
+            )
+        raise BadRequestError(f"unknown resetType {reset_type!r}")
 
     def query_workflow(
         self, domain: str, workflow_id: str, run_id: str = "",
@@ -240,11 +304,12 @@ class WorkflowHandler:
         if next_token < 0:
             # a token this handler issued from the archive (negative
             # tag distinguishes it from live event-id tokens): resume
-            # the archive read directly
+            # the archive read directly. Transient archiver failures
+            # propagate (retryable), only a truly-missing blob is 404
             archived = self._archived_history(
                 domain, workflow_id, run_id,
                 first_event_id=first_event_id, page_size=page_size,
-                next_token=-next_token,
+                next_token=-next_token, strict=True,
             )
             if archived is None:
                 raise EntityNotExistsServiceError(
@@ -276,7 +341,8 @@ class WorkflowHandler:
 
     def _archived_history(self, domain: str, workflow_id: str,
                           run_id: str, first_event_id: int = 1,
-                          page_size: int = 0, next_token: int = 0):
+                          page_size: int = 0, next_token: int = 0,
+                          strict: bool = False):
         from cadence_tpu.archival import URI
         from cadence_tpu.frontend.domain_handler import ArchivalStatus
 
@@ -301,11 +367,15 @@ class WorkflowHandler:
         except FileNotFoundError:
             return None
         except Exception:
-            # a broken archival config must not turn NOT_FOUND into an
-            # internal error — the caller re-raises the original
             self._log.exception(
                 f"archived-history read failed for {domain}/{workflow_id}"
             )
+            if strict:
+                # a resume KNOWS the blob exists — surface the
+                # retryable failure instead of faking a permanent 404
+                raise
+            # fresh-read fallback: the caller re-raises the original
+            # live-store NOT_FOUND
             return None
         events = [e for b in batches for e in b]
         if first_event_id > 1:

@@ -306,21 +306,11 @@ def pack_workflow(
             elif et == EventType.DecisionTaskCompleted:
                 attrs[0] = a.get("started_event_id", EMPTY_EVENT_ID)
                 pending_dec = None
-                checksum = a.get("binary_checksum", "") or ""
-                if checksum and all(
-                    p["binary_checksum"] != checksum
-                    for p in side.auto_reset_points
-                ):
-                    side.auto_reset_points.append({
-                        "binary_checksum": checksum,
-                        "run_id": side.run_id,
-                        "first_decision_completed_id": ev.event_id,
-                        "created_time": ev.timestamp,
-                        "resettable": True,
-                    })
-                    del side.auto_reset_points[
-                        : -MutableState.MAX_RESET_POINTS
-                    ]
+                MutableState.record_reset_point(
+                    side.auto_reset_points,
+                    a.get("binary_checksum", "") or "",
+                    side.run_id, ev.event_id, ev.timestamp,
+                )
 
             elif et == EventType.DecisionTaskTimedOut:
                 attrs[0] = a.get("timeout_type", 0)

@@ -263,6 +263,8 @@ def cmd_workflow(args) -> None:
             args.domain, args.workflow_id, args.run_id or "",
             reason=args.reason or "reset via cli",
             decision_finish_event_id=args.event_id,
+            reset_type=args.reset_type,
+            bad_binary_checksum=args.bad_binary_checksum,
         )
         _print({"new_run_id": new_run})
     elif wc == "query":
@@ -490,6 +492,10 @@ def build_parser() -> argparse.ArgumentParser:
         wp.add_argument("--signal-input", default="")
         wp.add_argument("--output", default="",
                         help="export: write history JSON here")
+        wp.add_argument("--reset-type", default="",
+                        help="reset: FirstDecisionCompleted | "
+                             "LastDecisionCompleted | BadBinary")
+        wp.add_argument("--bad-binary-checksum", default="")
     w.set_defaults(fn=cmd_workflow)
 
     t = sub.add_parser("tasklist")

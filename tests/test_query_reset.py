@@ -224,3 +224,71 @@ class TestLongPoll:
             e.event_type == EventType.DecisionTaskCompleted
             for e in got["events"]
         )
+
+
+def test_reset_by_type_bad_binary():
+    """resetType resolution (reference tools/cli resetTypes): BadBinary
+    resets to the last decision boundary before the bad binary."""
+    from cadence_tpu.runtime.api import (
+        BadRequestError,
+        StartWorkflowRequest,
+    )
+    from tests.test_frontend import FrontendBox
+
+    fb = FrontendBox()
+    fb.domain_handler.register_domain("rt-dom")
+    fe = fb.frontend
+    try:
+        run = fe.start_workflow_execution(
+            StartWorkflowRequest(
+                domain="rt-dom", workflow_id="rt-wf", workflow_type="t",
+                task_list="rt-tl",
+                execution_start_to_close_timeout_seconds=60,
+            )
+        )
+        task = fe.poll_for_decision_task(
+            "rt-dom", "rt-tl", identity="w", timeout_s=5
+        )
+        fe.respond_decision_task_completed(
+            task.task_token,
+            [Decision(DecisionType.StartTimer,
+                      {"timer_id": "t1",
+                       "start_to_fire_timeout_seconds": 1})],
+            binary_checksum="good-bin",
+        )
+        task2 = fe.poll_for_decision_task(
+            "rt-dom", "rt-tl", identity="w", timeout_s=10
+        )
+        assert task2 is not None
+        fe.respond_decision_task_completed(
+            task2.task_token,
+            [Decision(DecisionType.CompleteWorkflowExecution,
+                      {"result": b"tainted"})],
+            binary_checksum="bad-bin",
+        )
+
+        new_run = fe.reset_workflow_execution(
+            "rt-dom", "rt-wf", run, reason="bad deploy",
+            reset_type="BadBinary", bad_binary_checksum="bad-bin",
+        )
+        assert new_run and new_run != run
+        events, _ = fe.get_workflow_execution_history(
+            "rt-dom", "rt-wf", new_run
+        )
+        completed = [e for e in events
+                     if e.event_type == EventType.DecisionTaskCompleted]
+        assert completed
+        assert completed[0].attributes["binary_checksum"] == "good-bin"
+        assert not any(
+            e.event_type == EventType.WorkflowExecutionCompleted
+            for e in events
+        ), "the tainted completion must not survive the reset"
+
+        with pytest.raises(BadRequestError):
+            fe.reset_workflow_execution(
+                "rt-dom", "rt-wf", new_run, reset_type="Bogus"
+            )
+        with pytest.raises(BadRequestError):
+            fe.reset_workflow_execution("rt-dom", "rt-wf", new_run)
+    finally:
+        fb.stop()
