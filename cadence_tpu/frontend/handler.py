@@ -210,6 +210,11 @@ class WorkflowHandler:
         self._check(domain, **headers)
         self._check_id(workflow_id, "workflowId")
         if not decision_finish_event_id:
+            if not reset_type:
+                raise BadRequestError(
+                    "either decisionFinishEventId or resetType is "
+                    "required"
+                )
             if not run_id:
                 # pin the concrete run NOW: resolving the reset point
                 # against one run and resetting "the current run" later

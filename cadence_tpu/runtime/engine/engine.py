@@ -155,15 +155,61 @@ class HistoryEngine:
                     raise EntityNotExistsServiceError(
                         f"workflow {workflow_id}/{run_id} not found"
                     )
+                next_id_before = ms.next_event_id
                 try:
-                    return action(ctx, ms)
+                    out = action(ctx, ms)
                 except ConditionFailedError:
                     ctx.clear()
                     continue
+                # size check only after a MUTATING transaction (the
+                # reference enforces post-update; a read must never
+                # terminate as a side effect)
+                if ms.next_event_id > next_id_before:
+                    self._enforce_history_limits(ctx, ms)
+                return out
             raise InternalServiceError(
                 f"workflow {workflow_id} update failed after "
                 f"{_CONDITION_RETRY_COUNT} condition retries"
             )
+
+    # reference: dynamicconfig HistorySizeLimitError (200MB) /
+    # HistoryCountLimitError (200k events) — a runaway history is
+    # force-terminated before it can take the shard down with it
+    HISTORY_SIZE_LIMIT_BYTES = 200 * 1024 * 1024
+    HISTORY_COUNT_LIMIT = 200_000
+
+    def _enforce_history_limits(self, ctx, ms) -> None:
+        """Force-terminate a run whose history outgrew the limits
+        (reference workflowExecutionContext enforceSizeCheck)."""
+        ei = ms.execution_info
+        if not ms.is_workflow_execution_running():
+            return
+        if (
+            ei.history_size <= self.HISTORY_SIZE_LIMIT_BYTES
+            and ms.next_event_id <= self.HISTORY_COUNT_LIMIT
+        ):
+            return
+        self.log.warn(
+            f"terminating {ei.workflow_id}/{ei.run_id}: history "
+            f"{ei.history_size}B / {ms.next_event_id - 1} events "
+            "exceeds the limit"
+        )
+        try:
+            txn = self._txn(ctx, ms, ms.current_version)
+            txn.add_workflow_execution_terminated(
+                self.shard.now(),
+                reason="history size or count exceeds the limit",
+                identity="history-service",
+            )
+            result = txn.close()
+            ctx.update_workflow(ms, result)
+            self._notify(result)
+        except Exception:
+            # the cached ms was mutated by the staged terminate — drop
+            # it so the next load re-reads durable state instead of a
+            # closed-in-memory/running-in-store split brain
+            ctx.clear()
+            self.log.exception("history-limit termination failed")
 
     def _txn(
         self, ctx: WorkflowExecutionContext, ms: MutableState,

@@ -408,3 +408,35 @@ def test_timer_decision(env):
     types = [e.event_type for e in history]
     assert EventType.TimerStarted in types
     assert EventType.MarkerRecorded in types
+
+
+def test_history_count_limit_terminates_runaway(env):
+    """enforceSizeCheck (reference workflowExecutionContext): a history
+    past the count limit is force-terminated, not grown forever."""
+    from cadence_tpu.runtime.api import SignalRequest
+
+    _, _, engine = env
+    old_limit = engine.HISTORY_COUNT_LIMIT
+    engine.HISTORY_COUNT_LIMIT = 12
+    try:
+        run_id = engine.start_workflow_execution(start_req("runaway-wf"))
+        for i in range(12):
+            try:
+                engine.signal_workflow_execution(
+                    SignalRequest(
+                        domain="dom", workflow_id="runaway-wf",
+                        signal_name=f"s{i}", input=b"x",
+                    )
+                )
+            except Exception:
+                break  # terminated mid-storm: signals now bounce
+        events, _ = engine.get_workflow_execution_history(
+            "dom", "runaway-wf", run_id
+        )
+        assert events[-1].event_type == (
+            EventType.WorkflowExecutionTerminated
+        )
+        assert "limit" in events[-1].attributes.get("reason", "")
+        assert len(events) < 12 + 8, "termination did not stop the growth"
+    finally:
+        engine.HISTORY_COUNT_LIMIT = old_limit
