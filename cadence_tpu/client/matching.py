@@ -20,6 +20,9 @@ class MatchingClient:
             engines = {"matching": engines}
         self._engines: Dict[str, object] = dict(engines)
         self._monitor = monitor
+        # public: callers decorate responses with ring owners
+        # best-effort (RoutedMatchingClient overwrites with its own)
+        self.monitor = monitor
 
     def _engine_for(self, task_list: str):
         if len(self._engines) == 1 or self._monitor is None:
@@ -54,6 +57,11 @@ class MatchingClient:
     def describe_task_list(self, domain_id, name, task_type):
         return self._engine_for(name).describe_task_list(
             domain_id, name, task_type
+        )
+
+    def list_task_list_partitions(self, domain_id, name):
+        return self._engine_for(name).list_task_list_partitions(
+            domain_id, name
         )
 
     def cancel_outstanding_polls(self, domain_id, name, task_type):

@@ -527,6 +527,31 @@ class WorkflowHandler:
             domain_id, task_list, task_type
         )
 
+    def list_task_list_partitions(
+        self, domain: str, task_list: str, **headers
+    ) -> dict:
+        """Partition layout + owning hosts (reference
+        workflowHandler.ListTaskListPartitions)."""
+        domain_id = self._check(domain, **headers)
+        self._check_id(task_list, "taskList")
+        out = self.matching.list_task_list_partitions(
+            domain_id, task_list
+        )
+        # owner decoration is best-effort: an empty ring (startup
+        # race) must not fail the listing itself
+        monitor = getattr(self.matching, "monitor", None)
+        if monitor is not None:
+            resolver = monitor.resolver("matching")
+            for plist in out.values():
+                for p in plist:
+                    try:
+                        p["owner_host"] = resolver.lookup(
+                            p["name"]
+                        ).identity
+                    except RuntimeError:
+                        break  # no hosts joined yet
+        return out
+
     # -- visibility ----------------------------------------------------
 
     def _vis(self):

@@ -367,6 +367,26 @@ class MatchingEngine:
         out["pollers"] = self._poller_history(tl_id).get()
         return out
 
+    def list_task_list_partitions(
+        self, domain_id: str, name: str
+    ) -> dict:
+        """Partition names for a scalable task list (reference
+        matchingEngine ListTaskListPartitions): the union of read and
+        write partitioning, per task type."""
+        n = max(
+            self._n_read_partitions(domain=domain_id, task_list=name),
+            self._n_write_partitions(domain=domain_id, task_list=name),
+            1,
+        )
+        partitions = [
+            {"name": TaskListID.partition_name(name, i), "partition": i}
+            for i in range(n)
+        ]
+        return {
+            "decision_task_list_partitions": partitions,
+            "activity_task_list_partitions": [dict(p) for p in partitions],
+        }
+
     def cancel_outstanding_polls(
         self, domain_id: str, name: str, task_type: int
     ) -> None:

@@ -13,12 +13,25 @@ API from their orchestrator (k8s endpoints watch, etc.).
 from __future__ import annotations
 
 import bisect
+import hashlib
 import threading
 from typing import Callable, Dict, List, Optional
 
-from cadence_tpu.utils.hashing import fnv1a32
-
 _VNODES = 100  # virtual nodes per host for ring smoothness
+
+
+def _ring_hash(s: str) -> int:
+    """Ring position hash. NOT fnv1a32: FNV-1a over strings that differ
+    only in a trailing counter ("host#0", "host#1", ...) yields hashes
+    in arithmetic progression (stride = the FNV prime), so every host's
+    vnodes form a band and a two-host ring degenerates — measured ~45%
+    of adjacent-port host pairs put ALL 16 shard keys on one host. MD5
+    avalanches properly; ring rebuilds are rare, lookups hash one short
+    key."""
+    # usedforsecurity=False: this is a placement hash; FIPS-mode
+    # OpenSSL otherwise refuses md5 entirely
+    digest = hashlib.md5(s.encode(), usedforsecurity=False).digest()
+    return int.from_bytes(digest[:4], "big")
 
 
 class HostInfo:
@@ -57,7 +70,7 @@ class ServiceResolver:
         self._ring_hosts = {}
         for host in self._hosts:
             for v in range(_VNODES):
-                h = fnv1a32(f"{host}#{v}")
+                h = _ring_hash(f"{host}#{v}")
                 # first writer wins on (astronomically unlikely) collision
                 if h not in self._ring_hosts:
                     self._ring_hosts[h] = host
@@ -90,7 +103,7 @@ class ServiceResolver:
                 raise RuntimeError(
                     f"no hosts in service ring {self.service!r}"
                 )
-            h = fnv1a32(key)
+            h = _ring_hash(key)
             idx = bisect.bisect_left(self._ring, h)
             if idx == len(self._ring):
                 idx = 0

@@ -39,6 +39,7 @@ FRONTEND_OPS = (
     "list_workflow_executions", "scan_workflow_executions",
     "count_workflow_executions", "get_search_attributes",
     "list_archived_workflow_executions", "health",
+    "list_task_list_partitions",
 )
 
 HISTORY_OPS = (
@@ -63,6 +64,7 @@ MATCHING_OPS = (
     "poll_for_decision_task", "poll_for_activity_task",
     "query_workflow", "respond_query_task_completed",
     "describe_task_list", "cancel_outstanding_polls",
+    "list_task_list_partitions",
 )
 
 # queue task-execution metrics are tagged (queue=..., task_type=...)

@@ -494,3 +494,20 @@ def test_bad_binary_rejected_and_reset_points_recorded(fb):
     snap = desc["mutable_state"] or {}
     points = snap.get("execution_info", {}).get("auto_reset_points", [])
     assert [p["binary_checksum"] for p in points] == ["sha-good"]
+
+
+def test_list_task_list_partitions(fb):
+    # force a 3-partition task list through matching's dynamic config
+    fb.matching._n_read_partitions = lambda **kw: 3
+    fb.matching._n_write_partitions = lambda **kw: 3
+    out = fb.frontend.list_task_list_partitions("fe-domain", "scaled-tl")
+    expected_names = [
+        "scaled-tl",
+        "/__cadence_sys/scaled-tl/1",
+        "/__cadence_sys/scaled-tl/2",
+    ]
+    for key in ("decision_task_list_partitions",
+                "activity_task_list_partitions"):
+        parts = out[key]
+        assert [p["partition"] for p in parts] == [0, 1, 2], key
+        assert [p["name"] for p in parts] == expected_names, key

@@ -261,3 +261,27 @@ def test_shard_routing_spreads_workflows(box):
             task.task_token,
             [Decision(DecisionType.CompleteWorkflowExecution, {})],
         )
+
+
+def test_ring_distributes_shards_across_similar_identities():
+    """Regression: FNV-1a vnode hashing degenerated into arithmetic
+    progressions for 'host:port' identities differing only in the port,
+    leaving one host owning every shard ~45% of the time. The ring hash
+    must spread 16 shard keys across 2 near-identical hosts, always."""
+    import random
+
+    from cadence_tpu.runtime.membership import ServiceResolver
+
+    rng = random.Random(7)
+    for _ in range(100):
+        p = rng.randint(30000, 60000)
+        a = f"127.0.0.1:{p}"
+        b = f"127.0.0.1:{p + rng.randint(1, 30)}"
+        r = ServiceResolver("history")
+        r.set_hosts([a, b])
+        owned_b = sum(
+            1 for s in range(16) if r.lookup(str(s)).identity == b
+        )
+        assert 0 < owned_b < 16, (
+            f"degenerate ring for {a} / {b}: host B owns {owned_b}/16"
+        )
