@@ -19,15 +19,14 @@ class MatchingClient:
         if not isinstance(engines, dict):
             engines = {"matching": engines}
         self._engines: Dict[str, object] = dict(engines)
-        self._monitor = monitor
-        # public: callers decorate responses with ring owners
-        # best-effort (RoutedMatchingClient overwrites with its own)
+        # public: routing AND best-effort ring-owner decoration by
+        # callers (RoutedMatchingClient overwrites with its own)
         self.monitor = monitor
 
     def _engine_for(self, task_list: str):
-        if len(self._engines) == 1 or self._monitor is None:
+        if len(self._engines) == 1 or self.monitor is None:
             return next(iter(self._engines.values()))
-        host = self._monitor.resolver("matching").lookup(task_list).identity
+        host = self.monitor.resolver("matching").lookup(task_list).identity
         return self._engines.get(host) or next(iter(self._engines.values()))
 
     def add_decision_task(self, domain_id, workflow_id, run_id, task_list,

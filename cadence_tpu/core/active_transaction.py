@@ -991,6 +991,9 @@ class ActiveTransaction:
             domain_resolver=self.domain_resolver,
             id_generator=self.id_generator,
             retention_days=self.retention_days,
+            # active path: the engine manages stickiness explicitly
+            # (set on completion, cleared on decision failure/timeout)
+            preserve_stickiness=True,
         )
         _, _, new_run_ms = sb.apply_events(
             self.domain_id,

@@ -33,11 +33,18 @@ class StateBuilder:
         domain_resolver: Callable[[str], str] = lambda name: name,
         id_generator: Callable[[], str] = lambda: str(uuid.uuid4()),
         retention_days: int = 1,
+        preserve_stickiness: bool = False,
     ) -> None:
         self.ms = mutable_state
         self.domain_resolver = domain_resolver
         self.id_generator = id_generator
         self.retention_days = retention_days
+        # the reference clears worker stickiness when a REPLICATED
+        # batch applies (the remote worker's affinity means nothing
+        # here, stateBuilder.go:130); the ACTIVE transaction path runs
+        # through this same builder and must NOT wipe the affinity the
+        # engine just recorded
+        self.preserve_stickiness = preserve_stickiness
         self.transfer_tasks: List[T.TransferTask] = []
         self.timer_tasks: List[T.TimerTask] = []
         self.new_run_transfer_tasks: List[T.TransferTask] = []
@@ -87,7 +94,8 @@ class StateBuilder:
         ms = self.ms
 
         # workflow turned passive for this apply — reference :130
-        ms.clear_stickiness()
+        if not self.preserve_stickiness:
+            ms.clear_stickiness()
 
         for event in history:
             # version-history preamble — reference :134-155
@@ -125,6 +133,23 @@ class StateBuilder:
                         domain_id, ms.execution_info.task_list, decision.schedule_id
                     )
                 )
+                if ms.is_sticky_task_list_enabled():
+                    # sticky dispatch gets a ScheduleToStart timer so a
+                    # dead worker's decision falls back to the normal
+                    # list (reference mutableStateTaskGenerator
+                    # GenerateDecisionScheduleTasks sticky branch; the
+                    # timer queue clears stickiness when it fires)
+                    self.timer_tasks.append(
+                        T.TimerTask(
+                            task_type=TimerTaskType.DecisionTimeout,
+                            visibility_timestamp=event.timestamp
+                            + ms.execution_info.sticky_schedule_to_start_timeout
+                            * SECOND,
+                            timeout_type=int(TimeoutType.ScheduleToStart),
+                            event_id=decision.schedule_id,
+                            schedule_attempt=decision.attempt,
+                        )
+                    )
                 last_decision = decision
 
             elif et == EventType.DecisionTaskStarted:

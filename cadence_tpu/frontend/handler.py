@@ -542,14 +542,14 @@ class WorkflowHandler:
         monitor = getattr(self.matching, "monitor", None)
         if monitor is not None:
             resolver = monitor.resolver("matching")
-            for plist in out.values():
-                for p in plist:
-                    try:
+            try:
+                for plist in out.values():
+                    for p in plist:
                         p["owner_host"] = resolver.lookup(
                             p["name"]
                         ).identity
-                    except RuntimeError:
-                        break  # no hosts joined yet
+            except RuntimeError:
+                pass  # no hosts joined yet: return undecorated
         return out
 
     # -- visibility ----------------------------------------------------

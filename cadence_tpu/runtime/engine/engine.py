@@ -502,7 +502,17 @@ class HistoryEngine:
                 result = txn.close()
                 ctx.update_workflow(ms, result)
                 self._notify(result)
-            history, _ = ctx.read_history(ms)
+            # sticky dispatch ships only the delta since the worker's
+            # last decision — its cache holds the prefix (reference
+            # historyEngine createPollForDecisionTaskResponse: sticky ⇒
+            # partial history from previousStartedEventID + 1)
+            first = 1
+            if (
+                ms.is_sticky_task_list_enabled()
+                and ms.execution_info.last_processed_event > 0
+            ):
+                first = ms.execution_info.last_processed_event + 1
+            history, _ = ctx.read_history(ms, first_event_id=first)
             return {
                 "workflow_type": ms.execution_info.workflow_type_name,
                 "previous_started_event_id": ms.execution_info.last_processed_event,
@@ -579,11 +589,16 @@ class HistoryEngine:
             # reset points record in the shared StateBuilder replicate
             # path (mutable_state.replicate_decision_task_completed_
             # event) so active, replicated, and rebuilt state agree
-            # stickiness (reference: handleDecisionTaskCompleted)
+            # stickiness (reference: handleDecisionTaskCompleted).
+            # A non-positive timeout would arm an instantly-firing
+            # ScheduleToStart timer on every decision — normalize to
+            # the standard 5s sticky window
             if sticky_task_list:
                 ei.sticky_task_list = sticky_task_list
                 ei.sticky_schedule_to_start_timeout = (
                     sticky_schedule_to_start_timeout_seconds
+                    if sticky_schedule_to_start_timeout_seconds > 0
+                    else 5
                 )
             else:
                 ms.clear_stickiness()

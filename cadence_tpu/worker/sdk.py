@@ -26,6 +26,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 import traceback
+import uuid
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
 
 from cadence_tpu.core.enums import DecisionType, EventType
@@ -828,32 +830,70 @@ def replay_decide(
 
 
 class DecisionWorker:
+    """Decision poller with sticky execution.
+
+    Reference worker semantics: after the first decision the worker
+    advertises a host-specific sticky task list; the engine then
+    dispatches follow-up decisions there with a PARTIAL history (the
+    delta since the worker's previous decision), and the worker merges
+    it onto its cached prefix. A schedule-to-start timeout on the
+    sticky list falls back to the normal list with full history
+    (timer queue clears stickiness), so a dead worker never wedges the
+    workflow.
+    """
+
+    STICKY_TIMEOUT_S = 5
+    CACHE_RUNS = 200
+
     def __init__(
         self, frontend, domain: str, task_list: str,
         registry: WorkflowRegistry, identity: str = "decision-worker",
+        sticky: bool = True,
     ) -> None:
         self.frontend = frontend
         self.domain = domain
         self.task_list = task_list
         self.registry = registry
         self.identity = identity
+        self.sticky = sticky
+        self.sticky_task_list = (
+            f"{identity}:{uuid.uuid4().hex[:8]}:sticky" if sticky else ""
+        )
+        # (workflow_id, run_id) → contiguous event prefix seen so far
+        self._history_cache: "OrderedDict[tuple, List[HistoryEvent]]" = (
+            OrderedDict()
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def poll_and_process_one(self, timeout_s: float = 1.0) -> bool:
-        task = self.frontend.poll_for_decision_task(
-            self.domain, self.task_list,
-            identity=self.identity, timeout_s=timeout_s,
-        )
+        task = None
+        if self.sticky:
+            # drain the sticky list first (short poll), then the
+            # normal list — the reference worker multiplexes both
+            task = self.frontend.poll_for_decision_task(
+                self.domain, self.sticky_task_list,
+                identity=self.identity,
+                timeout_s=min(0.05, timeout_s),
+            )
+        if task is None:
+            task = self.frontend.poll_for_decision_task(
+                self.domain, self.task_list,
+                identity=self.identity, timeout_s=timeout_s,
+            )
         if task is None:
             return False
         if task.query is not None:
             self._answer_direct_query(task)
             return True
-        state = _ReplayState(task.history)
+        history = self._full_history(task)
+        state = _ReplayState(history)
         try:
-            decisions = replay_decide(self.registry, task.history, state)
+            decisions = replay_decide(self.registry, history, state)
         except Exception:
+            self._history_cache.pop(
+                (task.workflow_id, task.run_id), None
+            )
             self.frontend.respond_decision_task_failed(
                 task.task_token, identity=self.identity,
                 details=traceback.format_exc().encode(),
@@ -867,8 +907,36 @@ class DecisionWorker:
         self.frontend.respond_decision_task_completed(
             task.task_token, decisions, identity=self.identity,
             query_results=query_results or None,
+            sticky_task_list=self.sticky_task_list,
+            sticky_schedule_to_start_timeout_seconds=(
+                self.STICKY_TIMEOUT_S if self.sticky else 0
+            ),
         )
         return True
+
+    def _full_history(self, task) -> List[HistoryEvent]:
+        """Merge a (possibly partial) poll history onto the cached
+        prefix; a cache miss or gap re-reads the full history."""
+        key = (task.workflow_id, task.run_id)
+        events = list(task.history)
+        first = events[0].event_id if events else 1
+        if first > 1:
+            cached = self._history_cache.get(key, [])
+            prefix = [e for e in cached if e.event_id < first]
+            if not prefix or prefix[-1].event_id != first - 1:
+                # the sticky cache is cold (worker restart / eviction):
+                # fetch the real prefix instead of failing the decision
+                full, _ = self.frontend.get_workflow_execution_history(
+                    self.domain, task.workflow_id, task.run_id
+                )
+                prefix = [e for e in full if e.event_id < first]
+            events = prefix + events
+        if self.sticky:
+            self._history_cache[key] = events
+            self._history_cache.move_to_end(key)
+            while len(self._history_cache) > self.CACHE_RUNS:
+                self._history_cache.popitem(last=False)
+        return events
 
     def _run_query_handler(self, state, query_type: str, args: bytes):
         handler = self.registry.query_handler(state.workflow_type)

@@ -372,3 +372,97 @@ def test_side_effect_at_frontier_with_buffered_signal(box):
         assert events[-1].attributes["result"] == b"fresh:sig"
     finally:
         w.stop()
+
+
+def test_sticky_partial_history_and_fallback(box):
+    """Sticky execution: follow-up decisions arrive on the sticky list
+    with partial history; when the sticky worker is gone, the
+    schedule-to-start timeout falls back to the normal list with full
+    history (reference sticky semantics)."""
+    from cadence_tpu.worker.sdk import DecisionWorker, WorkflowRegistry
+
+    reg = WorkflowRegistry()
+
+    def wf(ctx, input):
+        payload = yield ctx.wait_signal("go")
+        return b"ok:" + payload
+
+    reg.register_workflow("sticky-wf", wf)
+    w = DecisionWorker(box.frontend, DOMAIN, TL, reg, identity="sw-1")
+    assert w.sticky_task_list
+
+    run = _start(box, "st-1", "sticky-wf")
+    # decision 1 arrives on the NORMAL list with full history
+    assert w.poll_and_process_one(timeout_s=5.0)
+
+    from cadence_tpu.runtime.api import SignalRequest
+
+    box.frontend.signal_workflow_execution(
+        SignalRequest(domain=DOMAIN, workflow_id="st-1",
+                      signal_name="go", input=b"hi")
+    )
+    # decision 2 must land on the STICKY list with a partial history
+    task = box.frontend.poll_for_decision_task(
+        DOMAIN, w.sticky_task_list, identity="probe", timeout_s=5.0
+    )
+    assert task is not None, "decision did not route to the sticky list"
+    assert task.history[0].event_id > 1, "sticky history was not partial"
+    # give it back by failing: engine reschedules
+    box.frontend.respond_decision_task_failed(
+        task.task_token, identity="probe", details=b"handing back"
+    )
+
+    # the worker (with its cache warm) completes from the merged view
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if w.poll_and_process_one(timeout_s=1.0):
+            desc = box.frontend.describe_workflow_execution(
+                DOMAIN, "st-1", run)
+            if not desc.is_running:
+                break
+    events, _ = box.frontend.get_workflow_execution_history(
+        DOMAIN, "st-1", run
+    )
+    assert events[-1].event_type == EventType.WorkflowExecutionCompleted
+    assert events[-1].attributes["result"] == b"ok:hi"
+
+
+def test_sticky_fallback_when_worker_dies(box):
+    """No one polls the sticky list: the decision times out
+    (ScheduleToStart) and re-dispatches on the normal list with FULL
+    history, so a fresh worker can pick it up."""
+    from cadence_tpu.worker.sdk import DecisionWorker, WorkflowRegistry
+
+    reg = WorkflowRegistry()
+
+    def wf(ctx, input):
+        payload = yield ctx.wait_signal("go")
+        return b"done:" + payload
+
+    reg.register_workflow("orphan-wf", wf)
+    # worker 1 takes decision 1, advertises stickiness, then "dies"
+    w1 = DecisionWorker(box.frontend, DOMAIN, TL, reg, identity="dead-1")
+    w1.STICKY_TIMEOUT_S = 1
+    run = _start(box, "st-2", "orphan-wf")
+    assert w1.poll_and_process_one(timeout_s=5.0)
+
+    from cadence_tpu.runtime.api import SignalRequest
+
+    box.frontend.signal_workflow_execution(
+        SignalRequest(domain=DOMAIN, workflow_id="st-2",
+                      signal_name="go", input=b"x")
+    )
+    # fresh worker with a COLD cache polls only the normal list
+    w2 = DecisionWorker(box.frontend, DOMAIN, TL, reg,
+                        identity="fresh-2", sticky=False)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        w2.poll_and_process_one(timeout_s=1.0)
+        desc = box.frontend.describe_workflow_execution(DOMAIN, "st-2", run)
+        if not desc.is_running:
+            break
+    events, _ = box.frontend.get_workflow_execution_history(
+        DOMAIN, "st-2", run
+    )
+    assert events[-1].event_type == EventType.WorkflowExecutionCompleted
+    assert events[-1].attributes["result"] == b"done:x"
