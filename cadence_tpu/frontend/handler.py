@@ -608,6 +608,14 @@ class WorkflowHandler:
         """Liveness probe (reference workflowHandler.Health)."""
         return {"ok": True, "service": "frontend"}
 
+    def get_cluster_info(self) -> dict:
+        """Server capabilities + supported client versions (reference
+        workflowHandler.GetClusterInfo)."""
+        return {
+            "supported_client_versions": dict(self.versions.supported),
+            "server": "cadence-tpu",
+        }
+
     def list_archived_workflow_executions(
         self, domain: str, query: str = "", page_size: int = 100,
         next_token: int = 0, **headers,

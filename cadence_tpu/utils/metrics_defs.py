@@ -39,7 +39,7 @@ FRONTEND_OPS = (
     "list_workflow_executions", "scan_workflow_executions",
     "count_workflow_executions", "get_search_attributes",
     "list_archived_workflow_executions", "health",
-    "list_task_list_partitions",
+    "list_task_list_partitions", "get_cluster_info",
 )
 
 HISTORY_OPS = (

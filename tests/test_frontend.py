@@ -511,3 +511,9 @@ def test_list_task_list_partitions(fb):
         parts = out[key]
         assert [p["partition"] for p in parts] == [0, 1, 2], key
         assert [p["name"] for p in parts] == expected_names, key
+
+
+def test_get_cluster_info(fb):
+    info = fb.frontend.get_cluster_info()
+    assert info["server"] == "cadence-tpu"
+    assert "cli" in info["supported_client_versions"]
