@@ -206,21 +206,13 @@ LOCAL_ACTIVITIES: Dict[str, Callable] = {
 
 
 def _wait_result(fe, domain, wf_id, run_id, timeout_s=20.0) -> bytes:
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        desc = fe.describe_workflow_execution(domain, wf_id, run_id)
-        if not desc.is_running:
-            events, _ = fe.get_workflow_execution_history(
-                domain, wf_id, run_id
-            )
-            last = events[-1]
-            if last.event_type != EventType.WorkflowExecutionCompleted:
-                raise AssertionError(
-                    f"closed as {last.event_type.name}: {last.attributes}"
-                )
-            return last.attributes.get("result", b"")
-        time.sleep(0.05)
-    raise TimeoutError(f"{wf_id} still running after {timeout_s}s")
+    """Wait for a COMPLETED close and return its result."""
+    last = _wait_close(fe, domain, wf_id, run_id, timeout_s)
+    if last.event_type != EventType.WorkflowExecutionCompleted:
+        raise AssertionError(
+            f"closed as {last.event_type.name}: {last.attributes}"
+        )
+    return last.attributes.get("result", b"")
 
 
 def _start(fe, domain, wf_type, wf_id, input=b"", timeout=120, **kw):

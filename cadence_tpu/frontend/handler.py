@@ -344,29 +344,46 @@ class WorkflowHandler:
                 raise
             return archived
 
+    def _archival_target(self, domain: str, kind: str):
+        """(parsed URI, domain_id) when ``kind`` ('history'/'visibility')
+        archival is enabled for the domain, else None."""
+        from cadence_tpu.archival import URI
+        from cadence_tpu.frontend.domain_handler import ArchivalStatus
+
+        rec = self.domains.get_by_name(domain)
+        cfg = rec.config
+        status = getattr(cfg, f"{kind}_archival_status")
+        uri = getattr(cfg, f"{kind}_archival_uri")
+        if status != ArchivalStatus.ENABLED or not uri:
+            return None
+        try:
+            return URI.parse(uri), rec.info.id
+        except Exception:
+            # a malformed archival URI reads as "not archived", never
+            # as an internal error on an unrelated request
+            self._log.exception(
+                f"domain {domain} has a malformed {kind} archival "
+                f"URI {uri!r}"
+            )
+            return None
+
     def _archived_history(self, domain: str, workflow_id: str,
                           run_id: str, first_event_id: int = 1,
                           page_size: int = 0, next_token: int = 0,
                           strict: bool = False):
-        from cadence_tpu.archival import URI
-        from cadence_tpu.frontend.domain_handler import ArchivalStatus
 
         if not run_id:
             return None  # the archive is keyed by concrete run
-        rec = self.domains.get_by_name(domain)
-        cfg = rec.config
-        if (
-            cfg.history_archival_status != ArchivalStatus.ENABLED
-            or not cfg.history_archival_uri
-        ):
+        target = self._archival_target(domain, "history")
+        if target is None:
             return None
+        uri, domain_id = target
         try:
-            uri = URI.parse(cfg.history_archival_uri)
             archiver = self._archival_provider().get_history_archiver(
                 uri.scheme
             )
             batches, token = archiver.get(
-                uri, rec.info.id, workflow_id, run_id,
+                uri, domain_id, workflow_id, run_id,
                 page_size=page_size, next_token=next_token,
             )
         except FileNotFoundError:
@@ -623,25 +640,18 @@ class WorkflowHandler:
         """Query the domain's visibility archive (reference
         workflowHandler.ListArchivedWorkflowExecutions — serves records
         whose retention already deleted them from live visibility)."""
-        from cadence_tpu.archival import URI
-        from cadence_tpu.frontend.domain_handler import ArchivalStatus
-
         self._check(domain, **headers)
-        rec = self.domains.get_by_name(domain)
-        cfg = rec.config
-        if (
-            cfg.visibility_archival_status != ArchivalStatus.ENABLED
-            or not cfg.visibility_archival_uri
-        ):
+        target = self._archival_target(domain, "visibility")
+        if target is None:
             raise BadRequestError(
                 f"domain {domain} has no visibility archival enabled"
             )
-        uri = URI.parse(cfg.visibility_archival_uri)
+        uri, domain_id = target
         archiver = self._archival_provider().get_visibility_archiver(
             uri.scheme
         )
         return archiver.query(
-            uri, rec.info.id, query,
+            uri, domain_id, query,
             page_size=page_size, next_token=next_token,
         )
 
