@@ -187,17 +187,21 @@ def test_continue_as_new(box):
     w.start()
     try:
         run_id = _start(box, "sdk-wf6", "chain", input=b"0")
+        # poll the CURRENT run's history for the terminal event itself:
+        # describe(current) can race continue-as-new (current swaps to
+        # the next run between resolve and load), so "not running" may
+        # be observed mid-chain
         deadline = time.monotonic() + 15.0
+        events = []
         while time.monotonic() < deadline:
-            desc = box.frontend.describe_workflow_execution(
+            events, _ = box.frontend.get_workflow_execution_history(
                 DOMAIN, "sdk-wf6"
-            )  # current run
-            if not desc.is_running:
+            )
+            if events and events[-1].event_type == (
+                EventType.WorkflowExecutionCompleted
+            ):
                 break
             time.sleep(0.05)
-        events, _ = box.frontend.get_workflow_execution_history(
-            DOMAIN, "sdk-wf6"
-        )
         assert events[-1].attributes["result"] == b"gen-2"
         # first run closed as continued-as-new
         first, _ = box.frontend.get_workflow_execution_history(
