@@ -279,14 +279,18 @@ class WorkflowHandler:
     def query_workflow(
         self, domain: str, workflow_id: str, run_id: str = "",
         query_type: str = "", query_args: bytes = b"",
-        timeout_s: float = 10.0, **headers,
+        timeout_s: float = 10.0, reject_not_open: bool = False,
+        **headers,
     ) -> bytes:
+        """reject_not_open: the reference's QueryRejectCondition — fail
+        the query instead of answering from a closed run's state."""
         self._check(domain, **headers)
         self._check_id(workflow_id, "workflowId")
         self._check_id(query_type, "queryType")
         return self.history.query_workflow(
             domain, workflow_id, run_id,
             query_type=query_type, query_args=query_args,
+            reject_not_open=reject_not_open,
             timeout_s=timeout_s,
         )
 

@@ -271,6 +271,7 @@ def cmd_workflow(args) -> None:
         out = fe.query_workflow(
             args.domain, args.workflow_id, args.run_id or "",
             query_type=args.type, timeout_s=args.timeout,
+            reject_not_open=args.reject_not_open,
         )
         _print({"result": out.decode(errors="replace")})
     elif wc == "list":
@@ -492,6 +493,9 @@ def build_parser() -> argparse.ArgumentParser:
         wp.add_argument("--signal-input", default="")
         wp.add_argument("--output", default="",
                         help="export: write history JSON here")
+        wp.add_argument("--reject-not-open", action="store_true",
+                        help="query: fail instead of answering from a "
+                             "closed run")
         wp.add_argument("--reset-type", default="",
                         help="reset: FirstDecisionCompleted | "
                              "LastDecisionCompleted | BadBinary")

@@ -292,3 +292,41 @@ def test_reset_by_type_bad_binary():
             fe.reset_workflow_execution("rt-dom", "rt-wf", new_run)
     finally:
         fb.stop()
+
+
+def test_query_reject_condition():
+    """reference QueryRejectCondition: reject_not_open fails queries on
+    a closed run instead of answering from stale state."""
+    from cadence_tpu.runtime.api import (
+        Decision,
+        QueryFailedError,
+        StartWorkflowRequest,
+    )
+    from tests.test_frontend import FrontendBox
+
+    fb = FrontendBox()
+    fb.domain_handler.register_domain("qr-dom")
+    fe = fb.frontend
+    try:
+        run = fe.start_workflow_execution(
+            StartWorkflowRequest(
+                domain="qr-dom", workflow_id="qr-wf", workflow_type="t",
+                task_list="qr-tl",
+                execution_start_to_close_timeout_seconds=60,
+            )
+        )
+        task = fe.poll_for_decision_task(
+            "qr-dom", "qr-tl", identity="w", timeout_s=5
+        )
+        fe.respond_decision_task_completed(
+            task.task_token,
+            [Decision(DecisionType.CompleteWorkflowExecution,
+                      {"result": b"bye"})],
+        )
+        with pytest.raises(QueryFailedError):
+            fe.query_workflow(
+                "qr-dom", "qr-wf", run, query_type="status",
+                reject_not_open=True, timeout_s=2.0,
+            )
+    finally:
+        fb.stop()
