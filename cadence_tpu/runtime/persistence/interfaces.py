@@ -134,9 +134,14 @@ class ExecutionManager:
     # -- timer queue --------------------------------------------------
 
     def get_timer_tasks(
-        self, shard_id: int, min_ts: int, max_ts: int, batch_size: int
+        self, shard_id: int, min_ts: int, max_ts: int, batch_size: int,
+        after_key: Optional[Tuple[int, int]] = None,
     ) -> List[TimerTask]:
-        """Tasks with min_ts <= visibility_timestamp < max_ts, time-ordered."""
+        """Tasks with min_ts <= visibility_timestamp < max_ts, ordered
+        by (visibility_timestamp, task_id). ``after_key`` is an
+        EXCLUSIVE (ts, task_id) resume cursor: pumps page past held
+        (deferred) tasks with it, so a span of waiting standby tasks
+        cannot starve everything behind them."""
         raise NotImplementedError
 
     def complete_timer_task(

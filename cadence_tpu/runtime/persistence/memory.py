@@ -346,7 +346,8 @@ class MemoryExecutionManager(I.ExecutionManager):
     # -- timer queue --------------------------------------------------
 
     def get_timer_tasks(
-        self, shard_id: int, min_ts: int, max_ts: int, batch_size: int
+        self, shard_id: int, min_ts: int, max_ts: int, batch_size: int,
+        after_key=None,
     ) -> List[TimerTask]:
         with self._lock:
             tasks = sorted(
@@ -354,6 +355,10 @@ class MemoryExecutionManager(I.ExecutionManager):
                     t
                     for (ts, _), t in self._timers.get(shard_id, {}).items()
                     if min_ts <= ts < max_ts
+                    and (
+                        after_key is None
+                        or (ts, t.task_id) > tuple(after_key)
+                    )
                 ),
                 key=lambda t: (t.visibility_timestamp, t.task_id),
             )

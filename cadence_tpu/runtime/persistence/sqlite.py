@@ -422,14 +422,22 @@ class SqliteExecutionManager(I.ExecutionManager):
                 (shard_id, exclusive_begin, inclusive_end),
             )
 
-    def get_timer_tasks(self, shard_id, min_ts, max_ts, batch_size):
+    def get_timer_tasks(self, shard_id, min_ts, max_ts, batch_size,
+                        after_key=None):
+        sql = (
+            "SELECT blob FROM timer_tasks WHERE shard_id=? AND "
+            "visibility_ts>=? AND visibility_ts<? "
+        )
+        params = [shard_id, min_ts, max_ts]
+        if after_key is not None:
+            sql += (
+                "AND (visibility_ts>? OR (visibility_ts=? AND task_id>?)) "
+            )
+            params += [after_key[0], after_key[0], after_key[1]]
+        sql += "ORDER BY visibility_ts, task_id LIMIT ?"
+        params.append(batch_size)
         with self.db.txn() as c:
-            rows = c.execute(
-                "SELECT blob FROM timer_tasks WHERE shard_id=? AND "
-                "visibility_ts>=? AND visibility_ts<? "
-                "ORDER BY visibility_ts, task_id LIMIT ?",
-                (shard_id, min_ts, max_ts, batch_size),
-            ).fetchall()
+            rows = c.execute(sql, params).fetchall()
         return [serde.timer_from_json(r[0]) for r in rows]
 
     def complete_timer_task(self, shard_id, visibility_ts, task_id):

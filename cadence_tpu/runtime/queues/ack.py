@@ -34,6 +34,14 @@ class QueueAckManager:
         self.read_level = ack_level
         self._outstanding: Dict[object, int] = {}  # key → state
         self._update_shard_ack = update_shard_ack
+        # cached min RETRY key (None = no retries): _bump_read_locked
+        # consults it on every add(), so it must not rescan the dict
+        self._retry_min = None
+        # pumps that keep their own read cursor (the timer pumps'
+        # _resume_key) register here; called whenever the read level is
+        # FORCED backwards (rewind / a defer retry firing) so the
+        # cursor can't skip the span the ack wants re-read
+        self.on_read_rewind: Optional[Callable[[], None]] = None
 
     def add(self, key) -> bool:
         """Register a read task; False if already outstanding (dup read)
@@ -46,13 +54,29 @@ class QueueAckManager:
             state = self._outstanding.get(key)
             if state is None:
                 self._outstanding[key] = _RUNNING
-                if key > self.read_level:
-                    self.read_level = key
+                self._bump_read_locked(key)
                 return True
             if state == _RETRY:
                 self._outstanding[key] = _RUNNING
+                if key == self._retry_min:
+                    self._recompute_retry_min_locked()
                 return True
             return False
+
+    def _recompute_retry_min_locked(self) -> None:
+        self._retry_min = min(
+            (k for k, s in self._outstanding.items() if s == _RETRY),
+            default=None,
+        )
+
+    def _bump_read_locked(self, level) -> None:
+        """Advance the read level, but never past a fired retry: its
+        ready() rewind happens ONCE, so skipping over it would strand
+        the task (read but never re-read) and wedge the ack sweep."""
+        if self._retry_min is not None and level >= self._retry_min:
+            return
+        if level > self.read_level:
+            self.read_level = level
 
     def complete(self, key) -> None:
         with self._lock:
@@ -94,13 +118,16 @@ class QueueAckManager:
             # being re-verified
             for key in [k for k in self._outstanding if k > level]:
                 del self._outstanding[key]
+            self._recompute_retry_min_locked()
             if self._update_shard_ack is not None:
                 self._update_shard_ack(level)
+            hook = self.on_read_rewind
+        if hook is not None:
+            hook()
 
     def set_read_level(self, level) -> None:
         with self._lock:
-            if level > self.read_level:
-                self.read_level = level
+            self._bump_read_locked(level)
 
     def outstanding(self) -> int:
         """In-flight work items. Parked entries (DEFERRED/RETRY) are not
@@ -125,9 +152,15 @@ class QueueAckManager:
 
         def ready() -> None:
             with self._lock:
-                if self._outstanding.get(key) == _DEFERRED:
-                    self._outstanding[key] = _RETRY
-                    self.read_level = self.ack_level
+                if self._outstanding.get(key) != _DEFERRED:
+                    return
+                self._outstanding[key] = _RETRY
+                self.read_level = self.ack_level
+                if self._retry_min is None or key < self._retry_min:
+                    self._retry_min = key
+                hook = self.on_read_rewind
+            if hook is not None:
+                hook()
 
         t = threading.Timer(delay_s, ready)
         t.daemon = True
@@ -139,5 +172,9 @@ class QueueAckManager:
         the task will be re-read before the sweep passes it (legacy
         callers); prefer defer()."""
         with self._lock:
-            self._outstanding.pop(key, None)
+            if self._outstanding.pop(key, None) == _RETRY:
+                self._recompute_retry_min_locked()
             self.read_level = self.ack_level
+            hook = self.on_read_rewind
+        if hook is not None:
+            hook()

@@ -26,6 +26,33 @@ from .allocator import DeferTask, defer_task
 _TASK_RETRY_COUNT = 3
 
 
+def read_due_timers(
+    execution, shard_id: int, min_ts: int, max_ts: int, batch_size: int,
+    resume_key, offer, max_pages: int = 16,
+):
+    """Page the due-timer window with an exclusive (ts, id) resume
+    cursor, shared by the active and standby timer pumps.
+
+    Calls ``offer(task, key)`` for every row read. Pages at most
+    ``max_pages`` per call; returns the cursor for the NEXT call —
+    ``None`` when the window was fully scanned (the next wake restarts
+    from the ack level, which also re-reads any fired defer-retries),
+    else the last page's key so a held span larger than one call's
+    budget keeps advancing instead of re-reading the same rows forever.
+    """
+    after = resume_key
+    for _ in range(max_pages):
+        batch = execution.get_timer_tasks(
+            shard_id, min_ts, max_ts, batch_size, after_key=after
+        )
+        for task in batch:
+            offer(task, (task.visibility_timestamp, task.task_id))
+        if len(batch) < batch_size:
+            return None
+        after = (batch[-1].visibility_timestamp, batch[-1].task_id)
+    return after
+
+
 @contextlib.contextmanager
 def timed_task(metrics: Scope, task):
     """Standard queue-task triple, tagged by task type: requests counter
@@ -121,6 +148,14 @@ class QueueProcessorBase:
                 if not self.ack.add(key):
                     continue  # already outstanding
                 self._pool.submit(self._run_task, task, key)
+            # advance the read cursor past everything READ, including
+            # keys add() rejected (parked/running/done): add() only
+            # advances it for newly-taken keys, so a full batch of
+            # already-outstanding tasks would otherwise re-read the
+            # identical rows forever and never leave this loop (no ack
+            # sweep, 100% CPU). Parked tasks are still re-read later —
+            # their retry timers rewind the read level to the ack level.
+            self.ack.set_read_level(self._task_key(batch[-1]))
             if len(batch) < self._batch_size:
                 return
 

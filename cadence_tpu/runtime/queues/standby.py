@@ -44,7 +44,7 @@ from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, defer_task
-from .base import QueueProcessorBase
+from .base import QueueProcessorBase, read_due_timers
 from .timer_gate import RemoteTimerGate
 
 
@@ -70,6 +70,10 @@ class QueueGC:
         self.standby_clusters = list(standby_clusters)
         self._interval = interval_s
         self._stopped = threading.Event()
+        # last collected levels: skip the range-delete round-trips when
+        # no cursor moved since the previous tick
+        self._last_transfer_min = 0
+        self._last_timer_min = 0
         self._gclog = get_logger(
             "cadence_tpu.queue.gc", shard=shard.shard_id
         )
@@ -98,10 +102,11 @@ class QueueGC:
                 for c in self.standby_clusters
             ]
         )
-        if transfer_min > 0:
+        if transfer_min > self._last_transfer_min:
             self.shard.persistence.execution.range_complete_transfer_tasks(
                 self.shard.shard_id, 0, transfer_min
             )
+            self._last_transfer_min = transfer_min
         timer_min = min(
             [self.timer_active.ack.ack_level[0]]
             + [
@@ -109,10 +114,11 @@ class QueueGC:
                 for c in self.standby_clusters
             ]
         )
-        if timer_min > 0:
+        if timer_min > self._last_timer_min:
             self.shard.persistence.execution.range_complete_timer_tasks(
                 self.shard.shard_id, 0, timer_min
             )
+            self._last_timer_min = timer_min
 
     def _loop(self) -> None:
         while not self._stopped.wait(self._interval):
@@ -128,18 +134,38 @@ class _StandbyAllocator:
     """Owns a task iff its domain is ACTIVE in ``cluster`` (i.e. this
     cluster stands by for it)."""
 
-    def __init__(self, domains, cluster: str) -> None:
+    def __init__(self, domains, cluster: str,
+                 local_cluster: str = "") -> None:
         self.domains = domains
         self.cluster = cluster
+        self.local_cluster = local_cluster
+        # domains this allocator has stood by for — a later flip to
+        # locally-active means a failover whose held span must hand
+        # over to the active processor
+        self._stood_by: set = set()
 
-    def owns(self, domain_id: str) -> bool:
+    def classify(self, domain_id: str) -> str:
+        """'owned' (verify here) | 'handover' (domain we stood by for
+        just became locally active — give the task to the active
+        plane, ONCE per failover observation) | 'other' (not ours)."""
         try:
             rec = self.domains.get_by_id(domain_id)
         except Exception:
-            return False
+            return "other"
         if not rec.is_global:
-            return False
-        return rec.replication_config.active_cluster_name == self.cluster
+            return "other"
+        active = rec.replication_config.active_cluster_name
+        if active == self.cluster:
+            self._stood_by.add(domain_id)
+            return "owned"
+        if domain_id in self._stood_by and active == self.local_cluster:
+            # one-shot: without the discard, every future task of the
+            # now-local domain would rewind the active cursor forever.
+            # The single handover covers the whole held span because
+            # the caller rewinds to the standby plane's ack level
+            self._stood_by.discard(domain_id)
+            return "handover"
+        return "other"
 
 
 class TransferQueueStandbyProcessor(QueueProcessorBase):
@@ -153,6 +179,8 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
         visibility=None,
         worker_count: int = 2,
         batch_size: int = 64,
+        local_cluster: str = "",
+        on_handover=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -165,7 +193,15 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
             "cadence_tpu.queue.transfer-standby",
             shard=shard.shard_id, cluster=cluster,
         )
-        self._allocator = _StandbyAllocator(engine.domains, cluster)
+        # called with an ack LEVEL when a domain this plane stood by
+        # for fails over HERE: rewinds the active cursor over the held
+        # span (closes the race where a standby worker observes the
+        # flipped domain before the failover listener rewinds, and the
+        # rewind target has already moved past the held span)
+        self._on_handover = on_handover
+        self._allocator = _StandbyAllocator(
+            engine.domains, cluster, local_cluster=local_cluster
+        )
         shard.ensure_cluster_ack_levels(cluster)
         ack = QueueAckManager(
             shard.get_cluster_transfer_ack_level(cluster),
@@ -191,7 +227,15 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
     # -- verification dispatch ----------------------------------------
 
     def _process(self, task: TransferTask) -> None:
-        if not self._allocator.owns(task.domain_id):
+        cls = self._allocator.classify(task.domain_id)
+        if cls != "owned":
+            if cls == "handover" and self._on_handover is not None:
+                # rewind the active plane over the whole held span:
+                # this plane's ack level lower-bounds every task it has
+                # read but not discharged
+                self._on_handover(
+                    min(task.task_id - 1, self.ack.ack_level)
+                )
             return  # locally-active (or other-cluster) task: not ours
         handler = {
             TransferTaskType.DecisionTask: self._verify_decision,
@@ -313,10 +357,13 @@ class TimerQueueStandbyProcessor:
         cluster: str,
         worker_count: int = 2,
         batch_size: int = 64,
+        local_cluster: str = "",
+        on_handover=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
         self.cluster = cluster
+        self._on_handover = on_handover
         self._log = get_logger(
             "cadence_tpu.queue.timer-standby",
             shard=shard.shard_id, cluster=cluster,
@@ -328,12 +375,19 @@ class TimerQueueStandbyProcessor:
                 cluster, lvl[0]
             ),
         )
+        # paged-read resume cursor; any forced read rewind (failover,
+        # defer retry firing) must drop it or the span would be skipped
+        self._resume_key = None
+        self._resume_drop = 0  # generation: a drop mid-scan must win
+        self.ack.on_read_rewind = self._drop_resume
         self.gate = RemoteTimerGate()
         self.gate.set_current_time(
             shard.get_remote_cluster_current_time(cluster)
         )
         shard.add_remote_time_listener(self._on_remote_time)
-        self._allocator = _StandbyAllocator(engine.domains, cluster)
+        self._allocator = _StandbyAllocator(
+            engine.domains, cluster, local_cluster=local_cluster
+        )
         self._stopped = threading.Event()
         from concurrent.futures import ThreadPoolExecutor
 
@@ -351,6 +405,11 @@ class TimerQueueStandbyProcessor:
     def _on_remote_time(self, cluster: str, now_ns: int) -> None:
         if cluster == self.cluster:
             self.gate.set_current_time(now_ns)
+
+    def _drop_resume(self) -> None:
+        self._resume_drop += 1
+        self._resume_key = None
+        self.gate.update(0)
 
     def start(self) -> None:
         self._pump_thread.start()
@@ -391,14 +450,23 @@ class TimerQueueStandbyProcessor:
         if remote_now <= 0:
             return  # no view of the remote clock yet: nothing is "due"
         min_ts = self.ack.ack_level[0]
-        batch = self.shard.persistence.execution.get_timer_tasks(
-            self.shard.shard_id, min_ts, remote_now + 1, self._batch_size
+
+        def offer(task, key):
+            if self.ack.add(key):
+                self._pool.submit(self._run_task, task, key)
+
+        # (ts, id)-cursor paging, persisted across wakes: a span of
+        # HELD tasks (waiting on replication) must not hide the due
+        # tasks behind it — retention deletes and other domains' timers
+        # keep flowing during replication lag, however large the span
+        drop_gen = self._resume_drop
+        resume = read_due_timers(
+            self.shard.persistence.execution, self.shard.shard_id,
+            min_ts, remote_now + 1, self._batch_size,
+            self._resume_key, offer,
         )
-        for task in batch:
-            key = (task.visibility_timestamp, task.task_id)
-            if not self.ack.add(key):
-                continue
-            self._pool.submit(self._run_task, task, key)
+        if drop_gen == self._resume_drop:
+            self._resume_key = resume
         future = self.shard.persistence.execution.get_timer_tasks(
             self.shard.shard_id, remote_now + 1, 2**62, 1
         )
@@ -434,7 +502,15 @@ class TimerQueueStandbyProcessor:
             # taskExecutor executeDeleteHistoryEventTask)
             self._delete_history(task)
             return
-        if not self._allocator.owns(task.domain_id):
+        cls = self._allocator.classify(task.domain_id)
+        if cls != "owned":
+            if cls == "handover" and self._on_handover is not None:
+                self._on_handover(
+                    min(
+                        (task.visibility_timestamp, task.task_id - 1),
+                        self.ack.ack_level,
+                    )
+                )
             return
         handler = {
             TimerTaskType.UserTimer: self._verify_user_timer,

@@ -26,7 +26,7 @@ from cadence_tpu.utils.metrics import NOOP
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, TaskAllocator, defer_task
-from .base import timed_task
+from .base import read_due_timers, timed_task
 from .timer_gate import LocalTimerGate
 
 _TIMEOUT_REASON = "cadenceInternal:Timeout"
@@ -58,6 +58,11 @@ class TimerQueueProcessor:
             (shard.get_timer_ack_level(), 0),
             update_shard_ack=lambda lvl: shard.update_timer_ack_level(lvl[0]),
         )
+        # paged-read resume cursor; any forced read rewind (failover,
+        # defer retry firing) must drop it or the span would be skipped
+        self._resume_key = None
+        self._resume_drop = 0  # generation: a drop mid-scan must win
+        self.ack.on_read_rewind = self._drop_resume
         self.gate = LocalTimerGate(time_source=shard.time_source)
         self._allocator = TaskAllocator(
             engine.domains, getattr(engine, "cluster_metadata", None)
@@ -70,6 +75,11 @@ class TimerQueueProcessor:
         self._pump_thread = threading.Thread(
             target=self._pump, name=f"timer-{shard.shard_id}-pump", daemon=True
         )
+
+    def _drop_resume(self) -> None:
+        self._resume_drop += 1
+        self._resume_key = None
+        self.gate.update(0)
 
     def start(self) -> None:
         self._pump_thread.start()
@@ -113,14 +123,22 @@ class TimerQueueProcessor:
     def _process_due(self) -> None:
         now = self.shard.now()
         min_ts = self.ack.ack_level[0]
-        batch = self.shard.persistence.execution.get_timer_tasks(
-            self.shard.shard_id, min_ts, now + 1, self._batch_size
+
+        def offer(task, key):
+            if self.ack.add(key):
+                self._pool.submit(self._run_task, task, key)
+
+        # (ts, id)-cursor paging, persisted across wakes: in-flight or
+        # held tasks at the front of the window must not hide due tasks
+        # behind them, however large the span
+        drop_gen = self._resume_drop
+        resume = read_due_timers(
+            self.shard.persistence.execution, self.shard.shard_id,
+            min_ts, now + 1, self._batch_size,
+            self._resume_key, offer,
         )
-        for task in batch:
-            key = (task.visibility_timestamp, task.task_id)
-            if not self.ack.add(key):
-                continue
-            self._pool.submit(self._run_task, task, key)
+        if drop_gen == self._resume_drop:
+            self._resume_key = resume
         # arm the gate with the next future deadline
         future = self.shard.persistence.execution.get_timer_tasks(
             self.shard.shard_id, now + 1, 2**62, 1

@@ -105,9 +105,28 @@ class HistoryService:
         processors = [transfer, timer]
         notifiers = [transfer.notify]
         timer_notifiers = [timer.notify]
+        local_cluster = (
+            self.cluster_metadata.current_cluster_name
+            if self.cluster_metadata is not None else ""
+        )
+
+        def transfer_handover(level, _t=transfer):
+            _t.ack.rewind(level)
+            _t.notify()
+
+        def timer_handover(level, _t=timer):
+            _t.ack.rewind(level)
+            _t.notify()
+
         for cluster in self.standby_clusters:
-            ts = TransferQueueStandbyProcessor(shard, engine, cluster)
-            tm = TimerQueueStandbyProcessor(shard, engine, cluster)
+            ts = TransferQueueStandbyProcessor(
+                shard, engine, cluster, local_cluster=local_cluster,
+                on_handover=transfer_handover,
+            )
+            tm = TimerQueueStandbyProcessor(
+                shard, engine, cluster, local_cluster=local_cluster,
+                on_handover=timer_handover,
+            )
             processors += [ts, tm]
             notifiers.append(ts.notify)
             timer_notifiers.append(tm.notify)

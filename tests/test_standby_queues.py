@@ -237,3 +237,92 @@ def test_failover_takeover_without_loss(box):
                     identity="probe", timeout_s=2.0)
     )
     assert task is not None
+
+
+def test_held_span_does_not_starve_timers_behind_it():
+    """Regression: the standby timer pump read only the first batch of
+    due tasks from the ack level; >= batch_size HELD tasks (waiting on
+    replication) starved every due task behind them. The keyed resume
+    cursor must page past the held span."""
+    from cadence_tpu.core.tasks import TimerTask
+    from cadence_tpu.core.enums import TimerTaskType
+    from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+
+    bundle = create_memory_bundle()
+    ex = bundle.execution
+    shard_id = 0
+    # seed 70 tasks at ts=1000.. then one at ts=5000
+    tasks = []
+    for i in range(70):
+        t = TimerTask(task_type=TimerTaskType.UserTimer,
+                      visibility_timestamp=1000 + i, task_id=100 + i)
+        tasks.append(t)
+    tail = TimerTask(task_type=TimerTaskType.DeleteHistoryEvent,
+                     visibility_timestamp=5000, task_id=999)
+    # store directly via the shard-independent put API
+    for t in tasks + [tail]:
+        ex._timers.setdefault(shard_id, {})[
+            (t.visibility_timestamp, t.task_id)
+        ] = t
+
+    # page with after_key exactly as the pump does (batch 64)
+    seen = []
+    after = None
+    for _ in range(16):
+        batch = ex.get_timer_tasks(shard_id, 0, 10**9, 64, after_key=after)
+        seen.extend((t.visibility_timestamp, t.task_id) for t in batch)
+        if len(batch) < 64:
+            break
+        after = (batch[-1].visibility_timestamp, batch[-1].task_id)
+    assert (5000, 999) in seen, "tail task never read past the held span"
+    assert len(seen) == 71
+
+
+def test_handover_rewinds_active_cursor_on_failover_race(box):
+    """Regression for the failover discharge race: a standby worker that
+    observes the flipped domain BEFORE the failover listener rewinds
+    must hand its task to the active plane by rewinding the active
+    cursor itself (monotone rewind → idempotent)."""
+    from cadence_tpu.runtime.queues.timer import TimerQueueProcessor
+    from cadence_tpu.runtime.queues.transfer import TransferQueueProcessor
+
+    ts, tm = box.standby_procs()
+    active_transfer = next(
+        p for p in box.handle().processors
+        if isinstance(p, TransferQueueProcessor)
+    )
+    _replicate_started_with_decision(box, "ho-wf", "ho-run")
+    # the standby holds the unreplicated decision task
+    assert _wait(lambda: ts._allocator.classify(box.domain_id) == "owned")
+
+    # simulate the active cursor racing AHEAD of the held task (the
+    # LISTENER rewind has not happened / targeted a too-far cursor)
+    active_transfer.ack.add(10_000)
+    active_transfer.ack.complete(10_000)
+    active_transfer.ack.update_ack_level()
+    assert active_transfer.ack.ack_level >= 10_000
+
+    # domain fails over HERE, flipping owns() before any listener runs.
+    # The LISTENER rewind is suppressed to model the exact race: the
+    # standby worker sees the flip first; only the handover path may
+    # fix the cursor.
+    box.domains._failover_listeners.clear()
+    rec = box.persistence.metadata.get_domain(id=box.domain_id)
+    rec.replication_config.active_cluster_name = "standby"
+    rec.failover_version = 12
+    box.persistence.metadata.update_domain(rec)
+    box.domains.get_by_id(box.domain_id)  # poke cache refresh
+
+    # feed a held-span task through the standby processor directly
+    from cadence_tpu.core.tasks import TransferTask
+    from cadence_tpu.core.enums import TransferTaskType
+
+    held = TransferTask(
+        task_type=TransferTaskType.DecisionTask,
+        domain_id=box.domain_id, workflow_id="ho-wf", run_id="ho-run",
+        task_id=77, schedule_id=2,
+    )
+    ts._process(held)
+    assert active_transfer.ack.ack_level <= 77 - 1, (
+        "handover did not rewind the active cursor over the held task"
+    )
