@@ -26,6 +26,35 @@ from .allocator import DeferTask, defer_task
 _TASK_RETRY_COUNT = 3
 
 
+class ResumeCursor:
+    """Paged-read resume cursor with a drop generation.
+
+    A forced read rewind (failover handover, a defer retry firing)
+    must WIN over a scan already in flight: ``drop()`` bumps the
+    generation, and ``store_if_current`` refuses to save a cursor
+    computed before the drop. All transitions are locked — the pump
+    thread and ack-hook threads race on this state."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._key = None
+        self._gen = 0
+
+    def begin(self):
+        with self._lock:
+            return self._key, self._gen
+
+    def store_if_current(self, key, gen) -> None:
+        with self._lock:
+            if gen == self._gen:
+                self._key = key
+
+    def drop(self) -> None:
+        with self._lock:
+            self._gen += 1
+            self._key = None
+
+
 def read_due_timers(
     execution, shard_id: int, min_ts: int, max_ts: int, batch_size: int,
     resume_key, offer, max_pages: int = 16,

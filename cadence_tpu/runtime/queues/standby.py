@@ -44,7 +44,7 @@ from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, defer_task
-from .base import QueueProcessorBase, read_due_timers
+from .base import QueueProcessorBase, ResumeCursor, read_due_timers
 from .timer_gate import RemoteTimerGate
 
 
@@ -159,13 +159,15 @@ class _StandbyAllocator:
             self._stood_by.add(domain_id)
             return "owned"
         if domain_id in self._stood_by and active == self.local_cluster:
-            # one-shot: without the discard, every future task of the
-            # now-local domain would rewind the active cursor forever.
-            # The single handover covers the whole held span because
-            # the caller rewinds to the standby plane's ack level
-            self._stood_by.discard(domain_id)
             return "handover"
         return "other"
+
+    def consume_handover(self, domain_id: str) -> None:
+        """One-shot: called AFTER the handover rewind actually ran —
+        without it, every future task of the now-local domain would
+        rewind the active cursor forever; consuming before the callback
+        runs would burn the only observation when none is wired."""
+        self._stood_by.discard(domain_id)
 
 
 class TransferQueueStandbyProcessor(QueueProcessorBase):
@@ -236,6 +238,7 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
                 self._on_handover(
                     min(task.task_id - 1, self.ack.ack_level)
                 )
+                self._allocator.consume_handover(task.domain_id)
             return  # locally-active (or other-cluster) task: not ours
         handler = {
             TransferTaskType.DecisionTask: self._verify_decision,
@@ -377,8 +380,7 @@ class TimerQueueStandbyProcessor:
         )
         # paged-read resume cursor; any forced read rewind (failover,
         # defer retry firing) must drop it or the span would be skipped
-        self._resume_key = None
-        self._resume_drop = 0  # generation: a drop mid-scan must win
+        self._resume = ResumeCursor()
         self.ack.on_read_rewind = self._drop_resume
         self.gate = RemoteTimerGate()
         self.gate.set_current_time(
@@ -407,8 +409,7 @@ class TimerQueueStandbyProcessor:
             self.gate.set_current_time(now_ns)
 
     def _drop_resume(self) -> None:
-        self._resume_drop += 1
-        self._resume_key = None
+        self._resume.drop()
         self.gate.update(0)
 
     def start(self) -> None:
@@ -459,14 +460,14 @@ class TimerQueueStandbyProcessor:
         # HELD tasks (waiting on replication) must not hide the due
         # tasks behind it — retention deletes and other domains' timers
         # keep flowing during replication lag, however large the span
-        drop_gen = self._resume_drop
-        resume = read_due_timers(
-            self.shard.persistence.execution, self.shard.shard_id,
-            min_ts, remote_now + 1, self._batch_size,
-            self._resume_key, offer,
+        key, gen = self._resume.begin()
+        self._resume.store_if_current(
+            read_due_timers(
+                self.shard.persistence.execution, self.shard.shard_id,
+                min_ts, remote_now + 1, self._batch_size, key, offer,
+            ),
+            gen,
         )
-        if drop_gen == self._resume_drop:
-            self._resume_key = resume
         future = self.shard.persistence.execution.get_timer_tasks(
             self.shard.shard_id, remote_now + 1, 2**62, 1
         )
@@ -511,6 +512,7 @@ class TimerQueueStandbyProcessor:
                         self.ack.ack_level,
                     )
                 )
+                self._allocator.consume_handover(task.domain_id)
             return
         handler = {
             TimerTaskType.UserTimer: self._verify_user_timer,

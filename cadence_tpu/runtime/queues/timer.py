@@ -26,7 +26,7 @@ from cadence_tpu.utils.metrics import NOOP
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, TaskAllocator, defer_task
-from .base import read_due_timers, timed_task
+from .base import ResumeCursor, read_due_timers, timed_task
 from .timer_gate import LocalTimerGate
 
 _TIMEOUT_REASON = "cadenceInternal:Timeout"
@@ -60,8 +60,7 @@ class TimerQueueProcessor:
         )
         # paged-read resume cursor; any forced read rewind (failover,
         # defer retry firing) must drop it or the span would be skipped
-        self._resume_key = None
-        self._resume_drop = 0  # generation: a drop mid-scan must win
+        self._resume = ResumeCursor()
         self.ack.on_read_rewind = self._drop_resume
         self.gate = LocalTimerGate(time_source=shard.time_source)
         self._allocator = TaskAllocator(
@@ -77,8 +76,7 @@ class TimerQueueProcessor:
         )
 
     def _drop_resume(self) -> None:
-        self._resume_drop += 1
-        self._resume_key = None
+        self._resume.drop()
         self.gate.update(0)
 
     def start(self) -> None:
@@ -131,14 +129,14 @@ class TimerQueueProcessor:
         # (ts, id)-cursor paging, persisted across wakes: in-flight or
         # held tasks at the front of the window must not hide due tasks
         # behind them, however large the span
-        drop_gen = self._resume_drop
-        resume = read_due_timers(
-            self.shard.persistence.execution, self.shard.shard_id,
-            min_ts, now + 1, self._batch_size,
-            self._resume_key, offer,
+        key, gen = self._resume.begin()
+        self._resume.store_if_current(
+            read_due_timers(
+                self.shard.persistence.execution, self.shard.shard_id,
+                min_ts, now + 1, self._batch_size, key, offer,
+            ),
+            gen,
         )
-        if drop_gen == self._resume_drop:
-            self._resume_key = resume
         # arm the gate with the next future deadline
         future = self.shard.persistence.execution.get_timer_tasks(
             self.shard.shard_id, now + 1, 2**62, 1
