@@ -143,6 +143,7 @@ class _StandbyAllocator:
         # locally-active means a failover whose held span must hand
         # over to the active processor
         self._stood_by: set = set()
+        self._claim_lock = threading.Lock()
 
     def classify(self, domain_id: str) -> str:
         """'owned' (verify here) | 'handover' (domain we stood by for
@@ -162,12 +163,22 @@ class _StandbyAllocator:
             return "handover"
         return "other"
 
-    def consume_handover(self, domain_id: str) -> None:
-        """One-shot: called AFTER the handover rewind actually ran —
-        without it, every future task of the now-local domain would
-        rewind the active cursor forever; consuming before the callback
-        runs would burn the only observation when none is wired."""
-        self._stood_by.discard(domain_id)
+    def claim_handover(self, domain_id: str) -> bool:
+        """Compare-and-consume: exactly ONE concurrent caller wins the
+        handover for a domain (two pool workers can both classify
+        'handover' for the same failover). Without consumption, every
+        future task of the now-local domain would rewind the active
+        cursor forever."""
+        with self._claim_lock:
+            if domain_id in self._stood_by:
+                self._stood_by.discard(domain_id)
+                return True
+            return False
+
+    def rearm_handover(self, domain_id: str) -> None:
+        """Give the claim back (the handover callback failed)."""
+        with self._claim_lock:
+            self._stood_by.add(domain_id)
 
 
 class TransferQueueStandbyProcessor(QueueProcessorBase):
@@ -231,14 +242,18 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
     def _process(self, task: TransferTask) -> None:
         cls = self._allocator.classify(task.domain_id)
         if cls != "owned":
-            if cls == "handover" and self._on_handover is not None:
-                # rewind the active plane over the whole held span:
-                # this plane's ack level lower-bounds every task it has
-                # read but not discharged
-                self._on_handover(
-                    min(task.task_id - 1, self.ack.ack_level)
-                )
-                self._allocator.consume_handover(task.domain_id)
+            if cls == "handover" and self._on_handover is not None \
+                    and self._allocator.claim_handover(task.domain_id):
+                try:
+                    # rewind the active plane over the whole held span:
+                    # this plane's ack level lower-bounds every task it
+                    # has read but not discharged
+                    self._on_handover(
+                        min(task.task_id - 1, self.ack.ack_level)
+                    )
+                except Exception:
+                    self._allocator.rearm_handover(task.domain_id)
+                    raise
             return  # locally-active (or other-cluster) task: not ours
         handler = {
             TransferTaskType.DecisionTask: self._verify_decision,
@@ -450,6 +465,9 @@ class TimerQueueStandbyProcessor:
         remote_now = self.gate.current_time()
         if remote_now <= 0:
             return  # no view of the remote clock yet: nothing is "due"
+        # begin() BEFORE reading the ack level: a rewind between the
+        # two bumps the generation and invalidates this scan's store
+        key, gen = self._resume.begin()
         min_ts = self.ack.ack_level[0]
 
         def offer(task, key):
@@ -460,7 +478,6 @@ class TimerQueueStandbyProcessor:
         # HELD tasks (waiting on replication) must not hide the due
         # tasks behind it — retention deletes and other domains' timers
         # keep flowing during replication lag, however large the span
-        key, gen = self._resume.begin()
         self._resume.store_if_current(
             read_due_timers(
                 self.shard.persistence.execution, self.shard.shard_id,
@@ -505,14 +522,18 @@ class TimerQueueStandbyProcessor:
             return
         cls = self._allocator.classify(task.domain_id)
         if cls != "owned":
-            if cls == "handover" and self._on_handover is not None:
-                self._on_handover(
-                    min(
-                        (task.visibility_timestamp, task.task_id - 1),
-                        self.ack.ack_level,
+            if cls == "handover" and self._on_handover is not None \
+                    and self._allocator.claim_handover(task.domain_id):
+                try:
+                    self._on_handover(
+                        min(
+                            (task.visibility_timestamp, task.task_id - 1),
+                            self.ack.ack_level,
+                        )
                     )
-                )
-                self._allocator.consume_handover(task.domain_id)
+                except Exception:
+                    self._allocator.rearm_handover(task.domain_id)
+                    raise
             return
         handler = {
             TimerTaskType.UserTimer: self._verify_user_timer,

@@ -120,6 +120,9 @@ class TimerQueueProcessor:
 
     def _process_due(self) -> None:
         now = self.shard.now()
+        # begin() BEFORE reading the ack level: a rewind between the
+        # two bumps the generation and invalidates this scan's store
+        key, gen = self._resume.begin()
         min_ts = self.ack.ack_level[0]
 
         def offer(task, key):
@@ -129,7 +132,6 @@ class TimerQueueProcessor:
         # (ts, id)-cursor paging, persisted across wakes: in-flight or
         # held tasks at the front of the window must not hide due tasks
         # behind them, however large the span
-        key, gen = self._resume.begin()
         self._resume.store_if_current(
             read_due_timers(
                 self.shard.persistence.execution, self.shard.shard_id,

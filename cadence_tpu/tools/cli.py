@@ -64,6 +64,7 @@ def cmd_server(args) -> None:
                 ("--db", args.db == ""), ("--port", args.port == 7933),
                 ("--shards", args.shards == 4),
                 ("--no-worker", not args.no_worker),
+                ("--pprof-port", args.pprof_port == 0),
             ) if not default
         ]
         if conflicting:
@@ -77,6 +78,13 @@ def cmd_server(args) -> None:
     from cadence_tpu.runtime.persistence.sqlite import create_sqlite_bundle
     from cadence_tpu.testing.onebox import Onebox
 
+    pprof = None
+    if args.pprof_port:
+        # bind BEFORE the heavyweight components: a bad port fails fast
+        # with nothing to tear down
+        from cadence_tpu.utils.pprof import PProfServer
+
+        pprof = PProfServer(port=args.pprof_port).start()
     persistence = (
         create_sqlite_bundle(args.db) if args.db else None
     )
@@ -89,7 +97,8 @@ def cmd_server(args) -> None:
         box.frontend, box.admin, address=f"127.0.0.1:{args.port}"
     ).start()
     print(f"cadence-tpu server listening on {server.address} "
-          f"(shards={args.shards}, db={args.db or 'memory'})")
+          f"(shards={args.shards}, db={args.db or 'memory'}"
+          + (f", pprof={pprof.address}" if pprof else "") + ")")
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
@@ -97,6 +106,8 @@ def cmd_server(args) -> None:
         while not stop:
             time.sleep(0.2)
     finally:
+        if pprof is not None:
+            pprof.stop()
         server.stop()
         box.stop()
 
@@ -439,6 +450,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=7933)
     s.add_argument("--shards", type=int, default=4)
     s.add_argument("--no-worker", action="store_true")
+    s.add_argument("--pprof-port", type=int, default=0,
+                   help="serve /debug/pprof diagnostics on this port")
     s.add_argument("--config", default="",
                    help="static YAML config (enables --services)")
     s.add_argument("--services", default="",
