@@ -92,21 +92,31 @@ class MatchingEngine:
         key = tl_id.key()
         with self._lock:
             mgr = self._managers.get(key)
-            if mgr is None:
-                forwarder = Forwarder(tl_id, self)
-                matcher = TaskMatcher(
-                    forward_offer=(
-                        forwarder.forward_offer if forwarder.enabled else None
-                    ),
-                    forward_poll=(
-                        forwarder.forward_poll if forwarder.enabled else None
-                    ),
-                )
-                mgr = TaskListManager(
-                    tl_id, self._store, matcher, time_source=self._time
-                )
-                self._managers[key] = mgr
+        if mgr is not None:
             return mgr
+        # construct OUTSIDE the engine lock: TaskListManager leases from
+        # the store (blocking I/O) and starts threads — holding the lock
+        # across that would stall every other task list's traffic
+        forwarder = Forwarder(tl_id, self)
+        matcher = TaskMatcher(
+            forward_offer=(
+                forwarder.forward_offer if forwarder.enabled else None
+            ),
+            forward_poll=(
+                forwarder.forward_poll if forwarder.enabled else None
+            ),
+        )
+        fresh = TaskListManager(
+            tl_id, self._store, matcher, time_source=self._time
+        )
+        with self._lock:
+            mgr = self._managers.get(key)
+            if mgr is None:
+                self._managers[key] = fresh
+                return fresh
+        # raced another creator: theirs won, ours unwinds
+        fresh.stop()
+        return mgr
 
     def _pick_partition(self, domain_id: str, name: str, write: bool) -> str:
         if TaskListID("", name, 0).is_partition:
@@ -204,6 +214,17 @@ class MatchingEngine:
                 continue
             except Exception as e:  # transient history failure
                 task.finish(e)
+                if task.sync:
+                    # a sync-matched task was never persisted; dropping
+                    # it here would strand the workflow until a timeout
+                    # fires — put it on the backlog for redelivery
+                    try:
+                        mgr.add_task(info)
+                    except Exception:
+                        self._log.exception(
+                            "failed to re-enqueue sync-matched task "
+                            f"{info.workflow_id}/{info.schedule_id}"
+                        )
                 raise
             task.finish(None)
             return task, resp
@@ -307,6 +328,10 @@ class MatchingEngine:
                 TaskListID.partition_name(task_list, i)
                 for i in range(n_parts)
             ] if not TaskListID("", task_list, 0).is_partition else [task_list]
+            # ONE budget end to end: the offer phase spends at most
+            # half, and the answer wait gets whatever remains — the
+            # caller's timeout_s is a hard deadline, not per phase
+            overall = time.monotonic() + timeout_s
             deadline = time.monotonic() + timeout_s / 2
             offered = False
             while not offered:
@@ -327,7 +352,7 @@ class MatchingEngine:
                 raise QueryFailedError(
                     f"no poller on task list {task_list} to answer query"
                 )
-            if not done.wait(timeout_s):
+            if not done.wait(max(0.0, overall - time.monotonic())):
                 raise QueryFailedError("query timed out")
             if slot.get("error"):
                 raise QueryFailedError(slot["error"])

@@ -64,17 +64,18 @@ class TaskMatcher:
 
     def offer(self, task, timeout: float = 0.0) -> bool:
         """Sync match: succeed only if a poller takes the task now (or
-        within ``timeout``). Reference matcher.Offer."""
+        within ``timeout``). Reference matcher.Offer. ``timeout`` is ONE
+        budget across the local and forwarded attempts — not one each."""
         if self._limiter is not None and not self._limiter.allow():
             return False
+        deadline = time.monotonic() + timeout
         with self._lock:
             if self._try_handoff(task):
                 return True
-        if self._forward_offer is not None and self._forward_offer(task, timeout):
+        if self._forward_offer is not None and self._forward_offer(
+            task, max(0.0, deadline - time.monotonic())
+        ):
             return True
-        if timeout <= 0:
-            return False
-        deadline = time.monotonic() + timeout
         while time.monotonic() < deadline and not self._shutdown.is_set():
             with self._lock:
                 if self._try_handoff(task):
@@ -113,6 +114,12 @@ class TaskMatcher:
             if slot.done:
                 return slot.task
             slot.cancelled = True
+            # remove now (O(active pollers)): abandoned slots must not
+            # accumulate on an idle task list that is long-polled
+            try:
+                self._slots.remove(slot)
+            except ValueError:
+                pass  # a producer already popped it mid-handoff scan
         # local miss: one forwarded attempt before giving up (matcher
         # polls the parent partition when the local backlog is dry)
         if self._forward_poll is not None and not self._shutdown.is_set():

@@ -179,6 +179,7 @@ class TaskWriter:
             for req in batch:
                 info = req.info
                 info.task_id = mgr._allocate_task_id()
+                mgr._last_written_id = info.task_id
                 if info.created_time == 0:
                     info.created_time = now
                 if (
@@ -197,6 +198,7 @@ class TaskWriter:
                 mgr._release()
                 for req in batch:
                     req.info.task_id = mgr._allocate_task_id()
+                    mgr._last_written_id = req.info.task_id
                 mgr._store.create_tasks(mgr._info, infos)
 
     def stop(self) -> None:
@@ -283,6 +285,11 @@ class TaskListManager:
         self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1
         self._max_task_id = self._info.range_id * RANGE_SIZE
         self._ack = QueueAckManager(self._info.ack_level)
+        # highest task id persisted by THIS manager's writer; read_level
+        # lags it while the reader pump is behind (backlog signal). A
+        # restart starts at 0: pre-existing rows surface via read_level
+        # within one pump interval
+        self._last_written_id = 0
         self._backlog_signal = threading.Event()
         self._stopped = threading.Event()
         self._last_activity = self._time.now()
@@ -344,8 +351,14 @@ class TaskListManager:
     # -- backlog pump (taskReader) --------------------------------------
 
     def _has_backlog(self) -> bool:
-        return self._ack.read_level > self._ack.ack_level or bool(
-            self._outstanding_count()
+        # three signals: read-but-unfinished span, in-flight tasks, and
+        # PERSISTED-but-unread writes (the writer may be ahead of the
+        # reader pump — sync-matching a fresh task past them would
+        # break FIFO dispatch)
+        return (
+            self._ack.read_level > self._ack.ack_level
+            or bool(self._outstanding_count())
+            or self._last_written_id > self._ack.read_level
         )
 
     def _outstanding_count(self) -> int:
