@@ -70,12 +70,23 @@ class _Db:
             # production boot: the operator runs `schema update`
             # explicitly (ref cmd/server/cadence.go:66 compat gate)
             check_compat(self.conn)
+        # manual transaction control: txn() issues BEGIN IMMEDIATE
+        # itself; the driver must not inject its own deferred BEGINs
+        self.conn.isolation_level = None
         self.lock = threading.RLock()
 
     @contextmanager
     def txn(self):
         with self.lock:
             try:
+                # BEGIN IMMEDIATE: python-sqlite3's legacy mode starts
+                # the transaction only at the first DML, so a
+                # check-then-write (the LWT pattern: current-execution
+                # probe, next_event_id condition, lease bump) would run
+                # its SELECT in autocommit and race a second PROCESS.
+                # Taking the reserved lock up front makes the whole
+                # block atomic across processes
+                self.conn.execute("BEGIN IMMEDIATE")
                 yield self.conn
                 self.conn.commit()
             except BaseException:
@@ -142,7 +153,11 @@ class SqliteExecutionManager(I.ExecutionManager):
         row = c.execute(
             "SELECT range_id FROM shards WHERE shard_id=?", (shard_id,)
         ).fetchone()
-        if row and row[0] > range_id:
+        if row is None:
+            # a missing shard row must FENCE, not bypass fencing (the
+            # memory backend raises here too — conformance)
+            raise EntityNotExistsError(f"shard {shard_id}")
+        if row[0] > range_id:
             raise ShardOwnershipLostError(shard_id)
 
     def _put_tasks(self, c, shard_id: int, snap: WorkflowSnapshot) -> None:
@@ -580,6 +595,20 @@ class SqliteHistoryManager(I.HistoryManager):
                 "DELETE FROM history_branches WHERE tree_id=? AND branch_id=?",
                 (branch.tree_id, branch.branch_id),
             )
+
+    def list_history_trees(self):
+        """All (tree_id, branch tokens) pairs — the history scavenger's
+        scan surface (reference GetAllHistoryTreeBranches); without it
+        the scavenger silently skips the durable backend."""
+        with self.db.txn() as c:
+            rows = c.execute(
+                "SELECT tree_id, token FROM history_branches "
+                "ORDER BY tree_id"
+            ).fetchall()
+        out = {}
+        for tree_id, blob in rows:
+            out.setdefault(tree_id, []).append(BranchToken.from_json(blob))
+        return list(out.items())
 
     def get_history_tree(self, tree_id: str) -> List[BranchToken]:
         with self.db.txn() as c:

@@ -425,3 +425,39 @@ def test_visibility_pagination(bundle):
     assert len(page3) == 1 and token == 0
     ids = [r.workflow_id for r in page1 + page2 + page3]
     assert ids == ["wf4", "wf3", "wf2", "wf1", "wf0"]
+
+
+class TestHistoryTrees:
+    def test_list_history_trees_both_backends(self, bundle):
+        """The scavenger's scan surface must exist on every backend —
+        sqlite silently lacked it and orphaned trees accumulated."""
+        h = bundle.history
+        b1 = h.new_history_branch(tree_id="tree-a")
+        b2 = h.new_history_branch(tree_id="tree-b")
+        trees = dict(h.list_history_trees())
+        assert set(trees) >= {"tree-a", "tree-b"}
+        assert any(t.branch_id == b1.branch_id for t in trees["tree-a"])
+        h.delete_history_branch(b2)
+        trees = dict(h.list_history_trees())
+        assert "tree-b" not in trees
+
+    def test_missing_shard_row_fences_writes(self, bundle):
+        """A write against a shard with no shard record must fence
+        (EntityNotExists), not bypass range checking."""
+        import pytest as _pytest
+
+        from cadence_tpu.runtime.persistence.errors import (
+            EntityNotExistsError,
+        )
+        from cadence_tpu.runtime.persistence.records import (
+            WorkflowSnapshot,
+        )
+
+        snap = WorkflowSnapshot(
+            domain_id="d", workflow_id="w", run_id="r",
+            snapshot={"execution_info": {}}, next_event_id=2,
+        )
+        with _pytest.raises(EntityNotExistsError):
+            bundle.execution.create_workflow_execution(
+                9999, 1, 0, snap
+            )
