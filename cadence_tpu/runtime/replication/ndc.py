@@ -16,14 +16,12 @@ contract.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional, Tuple
 
 from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.core.enums import EventType, WorkflowState
 from cadence_tpu.core.mutable_state import MutableState
 from cadence_tpu.core.state_builder import StateBuilder
-from cadence_tpu.core.tasks import ReplicationTask
 from cadence_tpu.core.version_history import (
     VersionHistories,
     VersionHistory,
@@ -378,18 +376,23 @@ class NDCHistoryReplicator:
         forked = self.shard.persistence.history.fork_history_branch(
             base_branch, lca_item.event_id + 1
         )
+        # items up to the LCA, with the BOUNDARY item appended when the
+        # LCA falls mid-item (base [(2,v0),(10,v1)], LCA (5,v1): the
+        # fork holds events 1-5, so its items must end at (5,v1) — not
+        # (2,v0), which would make the rebuild replay only events 1-2
+        # and silently lose 3-5. Reference
+        # CopyVersionHistoryUntilLCAVersionHistoryItem.
+        items = [
+            it for it in base_vh.items
+            if it.event_id <= lca_item.event_id
+        ]
+        if not items or items[-1].event_id < lca_item.event_id:
+            items.append(
+                VersionHistoryItem(lca_item.event_id, lca_item.version)
+            )
         new_vh = VersionHistory(
-            branch_token=forked.to_json().encode(),
-            items=[
-                it
-                for it in base_vh.items
-                if it.event_id <= lca_item.event_id
-            ]
-            or [lca_item],
+            branch_token=forked.to_json().encode(), items=items
         )
-        # clamp the boundary item to the LCA event id
-        if new_vh.items[-1].event_id > lca_item.event_id:
-            new_vh.items[-1] = lca_item
         _, new_index = local.add_version_history(new_vh)
         # add_version_history may have flipped current; restore — the
         # conflict resolver owns that decision
@@ -523,6 +526,12 @@ class NDCHistoryReplicator:
     def _snapshot(
         self, ms: MutableState, transfer, timer, zombie: bool = False
     ) -> WorkflowSnapshot:
+        if zombie:
+            # a ZOMBIE run is deliberately not current: enqueueing live
+            # transfer/timer tasks for it would mint decisions/timers
+            # for a suppressed run (reference nDCTransactionMgr zombie
+            # writes carry no task generation)
+            transfer, timer = [], []
         ei = ms.execution_info
         for t in list(transfer) + list(timer):
             if not t.domain_id:

@@ -109,6 +109,11 @@ class ReplicationTaskProcessor:
 
     # -- synchronous drain (tests + backlog catch-up) ------------------
 
+    # flush budget for the keyed fallback; drain() shrinks it to
+    # fit its own deadline so a failover drain isn't held hostage
+    # by one slow apply
+    APPLY_FLUSH_TIMEOUT_S = 120.0
+
     def process_once(self) -> int:
         """One fetch + apply cycle; returns number of tasks applied.
 
@@ -179,7 +184,7 @@ class ReplicationTaskProcessor:
                 (task.domain_id, task.workflow_id),
                 lambda t=task: run(t),
             )
-        if not seq.flush(timeout_s=120.0):
+        if not seq.flush(timeout_s=self.APPLY_FLUSH_TIMEOUT_S):
             # tasks still in flight: committing past them could lose
             # them forever (the cursor only moves forward). Raise —
             # returning 0 would read as "stream quiescent" to a
@@ -219,11 +224,19 @@ class ReplicationTaskProcessor:
 
     def drain(self, timeout_s: float = 5.0) -> bool:
         """Queue-processor drain contract (HistoryService.drain_queues):
-        True when the remote stream is quiescent within the budget."""
+        True when the remote stream is quiescent within the budget. The
+        keyed-apply flush budget shrinks to the caller's deadline for
+        the duration — a single hung apply must not turn a 5s drain
+        into a 120s stall."""
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self.process_once() == 0:
-                return True
+        saved = self.APPLY_FLUSH_TIMEOUT_S
+        self.APPLY_FLUSH_TIMEOUT_S = max(0.5, timeout_s)
+        try:
+            while time.monotonic() < deadline:
+                if self.process_once() == 0:
+                    return True
+        finally:
+            self.APPLY_FLUSH_TIMEOUT_S = saved
         return False
 
     def _process_task(self, task: HistoryTaskV2) -> None:

@@ -69,8 +69,23 @@ class ReplicatorQueueProcessor:
             )
             vh = (resp.snapshot or {}).get("version_histories")
             # VersionHistory.to_dict stores items as [event_id, version]
-            # pairs (cadence_tpu/core/version_history.py to_dict)
-            for h in (vh or {}).get("histories", []):
+            # pairs (cadence_tpu/core/version_history.py to_dict).
+            # Prefer the history whose branch_token matches the TASK's
+            # branch — after a resolved conflict the workflow carries
+            # several histories and picking by mere end-id coverage can
+            # ship another branch's items, making the passive side see
+            # "no common ancestor" and force a full resync
+            want_branch = (
+                task.branch_token.decode("latin-1")
+                if isinstance(task.branch_token, bytes)
+                else (task.branch_token or "")
+            )
+            histories = (vh or {}).get("histories", [])
+            ranked = sorted(
+                histories,
+                key=lambda h: h.get("branch_token", "") != want_branch,
+            ) if want_branch else histories
+            for h in ranked:
                 items = [
                     {"event_id": e, "version": v}
                     for e, v in h.get("items", [])

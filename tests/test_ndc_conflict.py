@@ -354,3 +354,74 @@ def test_older_version_new_run_stays_zombie(box):
     assert ex.get_current_execution(0, box.domain_id, wf).run_id == run_a
     # the zombie run exists but is not current
     assert ex.get_workflow_execution(0, box.domain_id, wf, run_b)
+
+
+def test_fork_at_mid_item_lca_keeps_boundary_events(box):
+    """Regression: when the LCA falls MID-item on the local side (the
+    shared prefix ends at a batch boundary inside a local version-
+    history item), the forked branch's items must end AT the LCA —
+    truncating to the previous literal item made the rebuild silently
+    drop the boundary events (here event 3)."""
+    V11 = 11  # cluster "active", second failover generation
+
+    wf, run = "wf-midlca", str(uuid.uuid4())
+    b1 = [
+        F.workflow_execution_started(
+            1, ACTIVE_V, T0, task_list="tl", workflow_type="wt",
+            execution_start_to_close_timeout_seconds=300,
+            task_start_to_close_timeout_seconds=10,
+        ),
+        F.decision_task_scheduled(2, ACTIVE_V, T0),
+    ]
+    b2 = [F.decision_task_started(3, V11, T0 + SECOND,
+                                  scheduled_event_id=2)]
+    b3 = [
+        F.decision_task_completed(4, V11, T0 + 2 * SECOND,
+                                  scheduled_event_id=2,
+                                  started_event_id=3),
+        F.decision_task_scheduled(5, V11, T0 + 2 * SECOND),
+    ]
+    box.engine.replicate_events_v2(_task(
+        box, wf, run, [{"event_id": 2, "version": ACTIVE_V}], b1, 1))
+    box.engine.replicate_events_v2(_task(
+        box, wf, run,
+        [{"event_id": 2, "version": ACTIVE_V},
+         {"event_id": 3, "version": V11}], b2, 2))
+    box.engine.replicate_events_v2(_task(
+        box, wf, run,
+        [{"event_id": 2, "version": ACTIVE_V},
+         {"event_id": 5, "version": V11}], b3, 3))
+    # local current: events 1-5, items [(2,1),(5,11)], batches
+    # [1,2],[3],[4,5]
+
+    # the divergent side shares only through batch [3] (event 3): its
+    # v12 continuation starts at event 4 — the LCA (3,11) falls INSIDE
+    # the local (5,11) item, at a batch boundary
+    divergent = [
+        F.decision_task_timed_out(4, STANDBY_V, T0 + 3 * SECOND,
+                                  scheduled_event_id=2,
+                                  started_event_id=3),
+        F.decision_task_scheduled(5, STANDBY_V, T0 + 3 * SECOND),
+        F.decision_task_started(6, STANDBY_V, T0 + 3 * SECOND,
+                                scheduled_event_id=5),
+    ]
+    box.engine.replicate_events_v2(_task(
+        box, wf, run,
+        [{"event_id": 2, "version": ACTIVE_V},
+         {"event_id": 3, "version": V11},
+         {"event_id": 6, "version": STANDBY_V}],
+        divergent, 4))
+
+    ms = _load_ms(box, wf, run)
+    current = ms.version_histories.get_current_version_history()
+    assert current.last_item().version == STANDBY_V
+    assert ms.next_event_id == 7
+    events, _ = box.engine.get_workflow_execution_history(DOMAIN, wf, run)
+    assert [e.event_id for e in events] == [1, 2, 3, 4, 5, 6], (
+        "boundary events lost in the fork"
+    )
+    assert [e.version for e in events] == [
+        ACTIVE_V, ACTIVE_V, V11, STANDBY_V, STANDBY_V, STANDBY_V,
+    ]
+    # the decision of the winning branch is the started(6) one
+    assert ms.execution_info.decision_started_id == 6
