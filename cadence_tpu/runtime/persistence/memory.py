@@ -510,8 +510,28 @@ class MemoryHistoryManager(I.HistoryManager):
 
     def delete_history_branch(self, branch: BranchToken) -> None:
         with self._lock:
-            self._nodes.pop((branch.tree_id, branch.branch_id), None)
-            tree = self._branches.get(branch.tree_id)
+            tree = self._branches.get(branch.tree_id) or {}
+            # nodes of this branch that OTHER branches still reference
+            # as ancestor segments must survive — deleting a forked-from
+            # branch (e.g. base-run retention after a reset) must not
+            # destroy the descendants' shared prefix (reference
+            # historyV2 deleteBranch keeps shared ranges)
+            protected_end = 0
+            for bid, token in tree.items():
+                if bid == branch.branch_id:
+                    continue
+                for anc in token.ancestors:
+                    if anc.branch_id == branch.branch_id:
+                        protected_end = max(
+                            protected_end, anc.end_node_id
+                        )
+            key = (branch.tree_id, branch.branch_id)
+            if protected_end:
+                nodes = self._nodes.get(key, {})
+                for nid in [n for n in nodes if n >= protected_end]:
+                    del nodes[nid]
+            else:
+                self._nodes.pop(key, None)
             if tree:
                 tree.pop(branch.branch_id, None)
                 if not tree:

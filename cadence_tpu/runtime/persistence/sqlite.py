@@ -587,10 +587,34 @@ class SqliteHistoryManager(I.HistoryManager):
 
     def delete_history_branch(self, branch) -> None:
         with self.db.txn() as c:
-            c.execute(
-                "DELETE FROM history_nodes WHERE tree_id=? AND branch_id=?",
-                (branch.tree_id, branch.branch_id),
-            )
+            # keep nodes other branches reference as ancestor segments
+            # (shared prefix of forks — see the memory twin)
+            protected_end = 0
+            rows = c.execute(
+                "SELECT branch_id, token FROM history_branches "
+                "WHERE tree_id=?",
+                (branch.tree_id,),
+            ).fetchall()
+            for bid, token in rows:
+                if bid == branch.branch_id:
+                    continue
+                for anc in BranchToken.from_json(token).ancestors:
+                    if anc.branch_id == branch.branch_id:
+                        protected_end = max(
+                            protected_end, anc.end_node_id
+                        )
+            if protected_end:
+                c.execute(
+                    "DELETE FROM history_nodes WHERE tree_id=? AND "
+                    "branch_id=? AND node_id>=?",
+                    (branch.tree_id, branch.branch_id, protected_end),
+                )
+            else:
+                c.execute(
+                    "DELETE FROM history_nodes WHERE tree_id=? AND "
+                    "branch_id=?",
+                    (branch.tree_id, branch.branch_id),
+                )
             c.execute(
                 "DELETE FROM history_branches WHERE tree_id=? AND branch_id=?",
                 (branch.tree_id, branch.branch_id),

@@ -119,11 +119,41 @@ class DomainReplicationProcessor:
                  group: str = "domain-replicator") -> None:
         self.consumer = bus.new_consumer("domain-replication", group)
         self.domain_handler = domain_handler
+        self._stop = threading.Event()
+        self._thread = None
 
     def process_backlog(self) -> int:
         return self.consumer.drain(
             lambda m: self.domain_handler.apply_replication_record(m.value)
         )
+
+    def start(self, interval_s: float = 0.05) -> None:
+        """Continuous pump (the worker service runs this like any other
+        consumer — without it, domain registrations/failovers published
+        by the master would never apply on this cluster)."""
+
+        def pump() -> None:
+            while not self._stop.is_set():
+                msg = self.consumer.poll(timeout=interval_s)
+                if msg is None:
+                    continue
+                try:
+                    self.domain_handler.apply_replication_record(msg.value)
+                except Exception:
+                    self.consumer.nack(msg)
+                else:
+                    self.consumer.ack(msg)
+
+        self._thread = threading.Thread(
+            target=pump, name="domain-replication", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
 
 
 def _task_to_dict(task: HistoryTaskV2) -> dict:

@@ -141,18 +141,37 @@ class ScannerActivities:
         return json.dumps({"scanned": scanned, "deleted": deleted}).encode()
 
     def _live_run_ids(self) -> set:
-        """All concrete-execution run ids, one scan per pass.
-        list_concrete_executions yields (domain_id, workflow_id, run_id)
-        tuples (persistence/memory.py)."""
+        """Run ids AND history-tree ids of every concrete execution.
+
+        Trees are keyed by the run that CREATED them — a reset forks
+        the new run's branch inside the ORIGINAL run's tree, so once
+        retention deletes the original execution, the reset run's life
+        depends on its branch token's tree_id being counted here; run
+        ids alone would let the scavenger destroy a live workflow's
+        history."""
+        from cadence_tpu.runtime.persistence.records import BranchToken
+
         live = set()
         for shard_id in range(self.num_shards):
             try:
-                for _, _, rid in self.execution.list_concrete_executions(
-                    shard_id
-                ):
-                    live.add(rid)
+                rows = self.execution.list_concrete_executions(shard_id)
             except Exception:
                 continue
+            for domain_id, wf_id, rid in rows:
+                live.add(rid)
+                try:
+                    resp = self.execution.get_workflow_execution(
+                        shard_id, domain_id, wf_id, rid
+                    )
+                    token = (resp.snapshot or {}).get(
+                        "execution_info", {}
+                    ).get("branch_token") or b""
+                    if isinstance(token, bytes):
+                        token = token.decode()
+                    if token:
+                        live.add(BranchToken.from_json(token).tree_id)
+                except Exception:
+                    continue  # unreadable: its run id stays live
         return live
 
 

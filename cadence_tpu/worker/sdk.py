@@ -585,6 +585,17 @@ class _Driver:
             wid = cmd.workflow_id
             child_idx = self.seq["c"]
             self.seq["c"] += 1
+            if child_idx < len(st.children_list) and (
+                st.children_list[child_idx] != wid
+            ):
+                # the Nth yield must match the Nth recorded initiation;
+                # silently crossing outcomes between reordered children
+                # corrupts downstream decisions
+                raise _NonDeterminismError(
+                    f"child #{child_idx} in history is "
+                    f"{st.children_list[child_idx]!r}, workflow code "
+                    f"started {wid!r}"
+                )
             outcome = st.child_outcome_by_index.get(child_idx)
             if outcome is not None:
                 self._consume()
@@ -1029,6 +1040,15 @@ class ActivityWorker:
             return True
         try:
             result = fn(task.input)
+            if result is None:
+                result = b""
+            if not isinstance(result, bytes):
+                # fail LOUDLY: silently recording b"" loses the result
+                # and surfaces far downstream in workflow code
+                raise TypeError(
+                    f"activity {task.activity_type!r} must return "
+                    f"bytes (or None), got {type(result).__name__}"
+                )
         except Exception as e:
             self.frontend.respond_activity_task_failed(
                 task.task_token, reason=str(e) or type(e).__name__,
@@ -1037,9 +1057,7 @@ class ActivityWorker:
             )
             return True
         self.frontend.respond_activity_task_completed(
-            task.task_token,
-            result=result if isinstance(result, bytes) else b"",
-            identity=self.identity,
+            task.task_token, result=result, identity=self.identity,
         )
         return True
 

@@ -73,6 +73,9 @@ class WorkerService:
             self.domain_replication = DomainReplicationProcessor(
                 bus, domain_handler
             )
+            # pumped like every other consumer — construction alone
+            # would leave published domain records unapplied forever
+            self.consumers.append(self.domain_replication)
         else:
             self.domain_replication = None
         if bus is not None and history_service is not None:

@@ -264,3 +264,60 @@ class TestWorkerService:
             assert box.frontend.describe_domain(name=SYSTEM_DOMAIN)
         finally:
             svc.stop()
+
+
+class TestScavengerResetSafety:
+    def test_scavenger_keeps_reset_run_tree_after_base_retention(self, box):
+        """Regression: a reset run's branch lives in the ORIGINAL run's
+        tree. After retention deletes the base run, tree liveness must
+        come from the reset run's branch token — run ids alone let the
+        scavenger destroy a live workflow's entire history."""
+        run1 = _start(box, "rs-wf")
+        # complete decision 1 so there's a reset point
+        task = box.frontend.poll_for_decision_task(
+            "wk-domain", "wk-tl", timeout_s=5.0
+        )
+        box.frontend.respond_decision_task_completed(
+            task.task_token,
+            [Decision(DecisionType.CompleteWorkflowExecution,
+                      {"result": b"done"})],
+        )
+        events, _ = box.frontend.get_workflow_execution_history(
+            "wk-domain", "rs-wf", run1
+        )
+        completed = next(
+            e for e in events
+            if e.event_type == EventType.DecisionTaskCompleted
+        )
+        run2 = box.frontend.reset_workflow_execution(
+            "wk-domain", "rs-wf", run1, reason="t",
+            decision_finish_event_id=completed.event_id,
+        )
+        # retention removes the BASE run (execution + its branch)
+        from cadence_tpu.runtime.queues.retention import (
+            delete_workflow_retention,
+        )
+        from cadence_tpu.utils.hashing import shard_for_workflow
+
+        class _T:
+            pass
+
+        t = _T()
+        domain_id = box.domains.get_by_name("wk-domain").info.id
+        t.domain_id, t.workflow_id, t.run_id = domain_id, "rs-wf", run1
+        sid = shard_for_workflow("rs-wf", 2)
+        engine = box.history.controller.get_engine_for_shard(sid)
+        delete_workflow_retention(engine.shard, engine, t)
+
+        acts = ScannerActivities(
+            box.persistence.task, box.persistence.history,
+            box.persistence.execution, num_shards=2,
+        )
+        json.loads(acts.scavenge_history())
+        out = json.loads(acts.scavenge_history())  # second pass deletes
+        # the live reset run's history must survive both passes
+        events2, _ = box.frontend.get_workflow_execution_history(
+            "wk-domain", "rs-wf", run2
+        )
+        assert events2, "reset run's history was scavenged"
+        assert events2[0].event_type == EventType.WorkflowExecutionStarted
