@@ -161,6 +161,16 @@ class HistoryEngine:
                 except ConditionFailedError:
                     ctx.clear()
                     continue
+                except BaseException:
+                    # the action may have mutated the cached ms before
+                    # failing (staged events, then a persistence I/O
+                    # error): drop the cache so the next load re-reads
+                    # durable state instead of serving a completed-in-
+                    # memory/unchanged-in-store split brain. Read-path
+                    # errors (no events staged) keep the cache warm
+                    if ms.next_event_id != next_id_before:
+                        ctx.clear()
+                    raise
                 # size check only after a MUTATING transaction (the
                 # reference enforces post-update; a read must never
                 # terminate as a side effect)
@@ -228,7 +238,6 @@ class HistoryEngine:
     def start_workflow_execution(
         self, request: StartWorkflowRequest, domain_id: str = "",
         signal_name: str = "", signal_input: bytes = b"",
-        prev_started_check: bool = True,
     ) -> str:
         """Returns the new run_id (reference historyEngine.go:408)."""
         request.validate()
@@ -382,10 +391,10 @@ class HistoryEngine:
         domain = self.domains.get_by_name(start.domain)
         # running workflow -> plain signal (reference historyEngine.go:1606)
         try:
-            run_id = self._current_run_id(domain.info.id, start.workflow_id)
             cur = self.shard.persistence.execution.get_current_execution(
                 self.shard.shard_id, domain.info.id, start.workflow_id
             )
+            run_id = cur.run_id
             if cur.state != int(WorkflowState.Completed):
                 self.signal_workflow_execution(
                     SignalRequest(
@@ -429,7 +438,16 @@ class HistoryEngine:
             ctx.update_workflow(ms, result)
             self._notify(result)
 
+        if not run_id:
+            # queries buffer under the CONCRETE run id
+            run_id = self._current_run_id(domain.info.id, workflow_id)
         self._update_workflow(domain.info.id, workflow_id, run_id, action)
+        # a terminated run never runs another decision: buffered
+        # consistent queries fail now rather than timing out
+        self.query_registry.fail_all(
+            domain.info.id, workflow_id, run_id,
+            "workflow terminated before the query could run",
+        )
 
     def request_cancel_workflow_execution(
         self, domain_name: str, workflow_id: str, run_id: str = "",
@@ -634,6 +652,13 @@ class HistoryEngine:
             ctx.update_workflow(ms, result)
             self._notify(result)
             committed.append(True)
+            if handler.workflow_closed:
+                # no carrier decision will ever run: buffered queries
+                # fail NOW instead of hanging out their full timeout
+                self.query_registry.fail_all(
+                    domain_id, workflow_id, run_id,
+                    "workflow closed before the query could run",
+                )
 
         committed: List[bool] = []
         self._update_workflow(domain_id, workflow_id, run_id, action)

@@ -24,7 +24,7 @@ from cadence_tpu.core.mutable_state import MutableState
 from cadence_tpu.core.state_builder import StateBuilder
 from cadence_tpu.core.version_history import VersionHistories
 
-from ..api import BadRequestError
+from ..api import BadRequestError, InternalServiceError
 from ..persistence.records import (
     BranchToken,
     CreateWorkflowMode,
@@ -111,9 +111,21 @@ class WorkflowResetor:
             self._close_old_run(ctx, ms, reason, identity)
 
             # persist the new run on a forked branch
-            self._persist_new_run(
-                ctx, ms, new_ms, result, decision_finish_event_id
-            )
+            try:
+                self._persist_new_run(
+                    ctx, ms, new_ms, result, decision_finish_event_id
+                )
+            except BaseException as e:
+                # the old run is already durably terminated; drop the
+                # cached state and surface a precise error so the
+                # operator retries the reset (idempotent: the old run
+                # terminates at most once, the new run id is fresh)
+                ctx.clear()
+                raise InternalServiceError(
+                    f"reset of {workflow_id}/{run_id} terminated the "
+                    f"old run but failed to create the new run: {e}; "
+                    "retry the reset"
+                ) from e
         engine._notify(result)
         return new_run_id
 
@@ -215,6 +227,13 @@ class WorkflowResetor:
                 transaction_id=self.shard.next_task_id(),
             )
         from cadence_tpu.core.task_refresher import refresh_tasks
+
+        # the new run inherits the forked prefix: carry the byte
+        # accounting so the 200MB history-size limit doesn't restart
+        # from zero after every reset
+        new_ms.execution_info.history_size = (
+            old_ms.execution_info.history_size
+        )
 
         transfer, timer = refresh_tasks(new_ms)
         ei = new_ms.execution_info

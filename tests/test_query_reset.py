@@ -330,3 +330,66 @@ def test_query_reject_condition():
             )
     finally:
         fb.stop()
+
+
+def test_buffered_query_fails_fast_when_workflow_closes():
+    """Liveness regression: a consistent query buffered behind an
+    in-flight decision must fail promptly when that decision CLOSES the
+    workflow — not hang out its full timeout."""
+    import threading
+    import time as _time
+
+    from cadence_tpu.runtime.api import (
+        Decision,
+        QueryFailedError,
+        StartWorkflowRequest,
+    )
+    from tests.test_frontend import FrontendBox
+
+    fb = FrontendBox()
+    fb.domain_handler.register_domain("qc-dom")
+    fe = fb.frontend
+    try:
+        fe.start_workflow_execution(
+            StartWorkflowRequest(
+                domain="qc-dom", workflow_id="qc-wf", workflow_type="t",
+                task_list="qc-tl",
+                execution_start_to_close_timeout_seconds=60,
+            )
+        )
+        task = fe.poll_for_decision_task(
+            "qc-dom", "qc-tl", identity="w", timeout_s=5
+        )
+        assert task is not None  # decision now in flight
+
+        outcome = {}
+
+        def querier():
+            t0 = _time.monotonic()
+            try:
+                fe.query_workflow(
+                    "qc-dom", "qc-wf", query_type="status",
+                    timeout_s=10.0,
+                )
+                outcome["result"] = "answered"
+            except QueryFailedError as e:
+                outcome["result"] = str(e)
+            outcome["elapsed"] = _time.monotonic() - t0
+
+        t = threading.Thread(target=querier)
+        t.start()
+        _time.sleep(0.3)  # let the query buffer behind the decision
+        fe.respond_decision_task_completed(
+            task.task_token,
+            [Decision(DecisionType.CompleteWorkflowExecution,
+                      {"result": b"bye"})],
+        )
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert "closed" in outcome.get("result", ""), outcome
+        assert outcome["elapsed"] < 5.0, (
+            f"query hung {outcome['elapsed']:.1f}s instead of failing "
+            "fast on close"
+        )
+    finally:
+        fb.stop()
