@@ -26,6 +26,10 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from cadence_tpu.utils.log import get_logger
+
+_log = get_logger("cadence_tpu.messaging")
+
 
 @dataclasses.dataclass
 class Message:
@@ -35,6 +39,9 @@ class Message:
     offset: int = -1
     partition: int = 0
     redelivery_count: int = 0
+    # why the last handler attempt failed (set by Consumer.drain) —
+    # rides into the DLQ so dead letters carry their diagnosis
+    last_error: str = ""
 
 
 class _TopicLog:
@@ -202,7 +209,15 @@ class Consumer:
             seen += 1
             try:
                 handler(msg)
-            except Exception:
+            except Exception as e:
+                # keep the WHY: the DLQ entry and the log both carry
+                # the failure, or a poisoned message dead-letters with
+                # zero diagnostics
+                msg.last_error = f"{type(e).__name__}: {e}"
+                _log.exception(
+                    f"handler failed for {msg.topic!r} message "
+                    f"{getattr(msg, 'offset', '?')}"
+                )
                 self.nack(msg)
             else:
                 self.ack(msg)

@@ -10,31 +10,10 @@ from typing import Any
 
 import grpc
 
-from cadence_tpu.frontend.domain_handler import DomainAlreadyExistsError
-from cadence_tpu.frontend.version_checker import ClientVersionNotSupportedError
-from cadence_tpu.runtime import api as A
-
 from . import codec
+from .errors import ERROR_TYPES
 
 _SERVICE = "cadence_tpu.Frontend"
-
-from cadence_tpu.runtime.controller import ShardOwnershipLostError
-
-ERROR_TYPES = {
-    "ShardOwnershipLostError": ShardOwnershipLostError,
-    "BadRequestError": A.BadRequestError,
-    "EntityNotExistsServiceError": A.EntityNotExistsServiceError,
-    "EntityNotExistsError": A.EntityNotExistsServiceError,
-    "WorkflowExecutionAlreadyStartedServiceError": (
-        A.WorkflowExecutionAlreadyStartedServiceError
-    ),
-    "DomainAlreadyExistsError": DomainAlreadyExistsError,
-    "DomainNotActiveError": A.DomainNotActiveError,
-    "CancellationAlreadyRequestedError": A.CancellationAlreadyRequestedError,
-    "QueryFailedError": A.QueryFailedError,
-    "ServiceBusyError": A.ServiceBusyError,
-    "InternalServiceError": A.InternalServiceError,
-}
 
 
 class _Method:

@@ -78,13 +78,22 @@ def encode(obj: Any) -> Any:
     if isinstance(obj, list):
         return [encode(v) for v in obj]
     if isinstance(obj, dict):
-        enc = {str(k): encode(v) for k, v in obj.items()}
-        if any(k in enc for k in ("__b", "__ev", "__t", "__dc", "__esc")):
+        for k in obj:
+            if not isinstance(k, str):
+                # silent str() coercion would corrupt int-keyed maps on
+                # round-trip (d[1] -> KeyError server-side); fail loud
+                raise TypeError(
+                    f"cannot encode dict key {k!r} "
+                    f"({type(k).__name__}): wire dicts are str-keyed"
+                )
+        enc = {k: encode(v) for k, v in obj.items()}
+        if any(k in enc for k in ("__b", "__ev", "__t", "__dc", "__esc",
+                                  "__s")):
             # user payloads may legitimately carry marker-shaped keys
             return {"__esc": enc}
         return enc
     if isinstance(obj, (set, frozenset)):
-        return {"__t": [encode(v) for v in sorted(obj)]}
+        return {"__s": [encode(v) for v in sorted(obj)]}
     raise TypeError(f"cannot encode {type(obj).__name__}")
 
 
@@ -98,6 +107,8 @@ def decode(obj: Any) -> Any:
             return HistoryEvent.from_dict(obj["__ev"])
         if "__t" in obj and len(obj) == 1:
             return tuple(decode(v) for v in obj["__t"])
+        if "__s" in obj and len(obj) == 1:
+            return set(decode(v) for v in obj["__s"])
         if "__esc" in obj and len(obj) == 1:
             return {k: decode(v) for k, v in obj["__esc"].items()}
         if "__dc" in obj:

@@ -21,33 +21,22 @@ from typing import Optional
 
 import grpc
 
-from cadence_tpu.runtime import api as A
-
 from . import codec
+from .errors import ERROR_CODES
 
 FRONTEND_SERVICE = "cadence_tpu.Frontend"
 HISTORY_SERVICE = "cadence_tpu.History"
 MATCHING_SERVICE = "cadence_tpu.Matching"
-_SERVICE = FRONTEND_SERVICE  # compat
 
-# error class name → grpc status (client reverses via ERROR_TYPES)
-ERROR_CODES = {
-    "BadRequestError": grpc.StatusCode.INVALID_ARGUMENT,
-    "EntityNotExistsServiceError": grpc.StatusCode.NOT_FOUND,
-    "EntityNotExistsError": grpc.StatusCode.NOT_FOUND,
-    "WorkflowExecutionAlreadyStartedServiceError": (
-        grpc.StatusCode.ALREADY_EXISTS
-    ),
-    "DomainAlreadyExistsError": grpc.StatusCode.ALREADY_EXISTS,
-    "DomainNotActiveError": grpc.StatusCode.FAILED_PRECONDITION,
-    "CancellationAlreadyRequestedError": grpc.StatusCode.ALREADY_EXISTS,
-    "QueryFailedError": grpc.StatusCode.FAILED_PRECONDITION,
-    "ServiceBusyError": grpc.StatusCode.RESOURCE_EXHAUSTED,
-    "ClientVersionNotSupportedError": grpc.StatusCode.FAILED_PRECONDITION,
-    "InternalServiceError": grpc.StatusCode.INTERNAL,
-    # shard moved: retryable routing error (retryableClient.go)
-    "ShardOwnershipLostError": grpc.StatusCode.UNAVAILABLE,
-}
+# lifecycle/assembly methods must NOT be remotely callable — the
+# generic by-name dispatch would otherwise let anyone who can reach
+# the port shut a service down or corrupt routing
+DISPATCH_DENYLIST = frozenset({
+    "start", "stop", "shutdown", "close", "wire", "drain",
+    "drain_queues", "notify", "add_host", "remove_host",
+    "unload_idle_task_lists", "enable_replication_from",
+    "acquire_shards", "release_shard",
+})
 
 
 class _Generic(grpc.GenericRpcHandler):
@@ -56,9 +45,11 @@ class _Generic(grpc.GenericRpcHandler):
         self._service = service
 
     def _resolve(self, name: str):
+        if name.startswith("_") or name in DISPATCH_DENYLIST:
+            return None
         for target in self._targets:
             fn = getattr(target, name, None)
-            if fn is not None and callable(fn) and not name.startswith("_"):
+            if fn is not None and callable(fn):
                 return fn
         return None
 
@@ -92,7 +83,7 @@ class ServiceRPCServer:
 
     def __init__(
         self, service: str, targets, address: str = "127.0.0.1:0",
-        max_workers: int = 16, server: Optional[grpc.Server] = None,
+        max_workers: int = 64, server: Optional[grpc.Server] = None,
     ) -> None:
         self.service = service
         self._owns_server = server is None
@@ -119,7 +110,7 @@ class ServiceRPCServer:
 class FrontendRPCServer(ServiceRPCServer):
     def __init__(
         self, frontend, admin=None, address: str = "127.0.0.1:0",
-        max_workers: int = 16,
+        max_workers: int = 64,
     ) -> None:
         targets = [frontend] + ([admin] if admin is not None else [])
         super().__init__(FRONTEND_SERVICE, targets, address, max_workers)
@@ -132,7 +123,7 @@ class HistoryRPCServer(ServiceRPCServer):
 
     def __init__(
         self, history_service, address: str = "127.0.0.1:0",
-        max_workers: int = 16, server: Optional[grpc.Server] = None,
+        max_workers: int = 64, server: Optional[grpc.Server] = None,
     ) -> None:
         from cadence_tpu.client.history import HistoryClient
 
@@ -146,7 +137,7 @@ class HistoryRPCServer(ServiceRPCServer):
 class MatchingRPCServer(ServiceRPCServer):
     def __init__(
         self, matching_engine, address: str = "127.0.0.1:0",
-        max_workers: int = 16, server: Optional[grpc.Server] = None,
+        max_workers: int = 64, server: Optional[grpc.Server] = None,
     ) -> None:
         super().__init__(
             MATCHING_SERVICE, [matching_engine], address, max_workers,
