@@ -168,10 +168,38 @@ class ActiveTransaction:
 
     def _flush_buffered(self) -> None:
         """Assign IDs to buffered events and append them to the batch
-        (called right after a decision-close event enters the batch)."""
+        (called right after a decision-close event enters the batch).
+
+        Cross-references are patched the way the reference's
+        assignEventIDToBufferedEvents does: a close event buffered
+        before its lazily-materialized started event carries a sentinel
+        ``started_event_id`` — once the started event gets its real id,
+        every sibling referencing the same scheduled/initiated event is
+        rewritten to it."""
+        started_by_sched: dict = {}   # scheduled_event_id → started id
+        started_by_init: dict = {}    # initiated_event_id → started id
         for event in self.ms.buffered_events:
             event.event_id = self._next_id()
             self.batch.append(event)
+            a = event.attributes
+            if event.event_type == EventType.ActivityTaskStarted:
+                started_by_sched[a.get("scheduled_event_id")] = (
+                    event.event_id
+                )
+            elif event.event_type == EventType.ChildWorkflowExecutionStarted:
+                started_by_init[a.get("initiated_event_id")] = (
+                    event.event_id
+                )
+        for event in self.batch:
+            a = event.attributes
+            sid = a.get("started_event_id")
+            if sid is None or sid >= 0:
+                continue
+            real = started_by_sched.get(a.get("scheduled_event_id"))
+            if real is None:
+                real = started_by_init.get(a.get("initiated_event_id"))
+            if real is not None:
+                a["started_event_id"] = real
         self.ms.buffered_events = []
 
     def _buffered(self, event_type: EventType, **attr_match: Any) -> bool:

@@ -109,6 +109,9 @@ class ExecutionInfo:
     # cron
     cron_schedule: str = ""
     expiration_seconds: int = 0
+    # first-decision backoff (cron/retry continued runs): absolute ns
+    # deadline; task refresh re-arms the WorkflowBackoffTimer from it
+    first_decision_backoff_deadline: int = 0
     # stats
     history_size: int = 0
 
@@ -185,6 +188,12 @@ class RequestCancelInfo:
     initiated_id: int = EMPTY_EVENT_ID
     initiated_event_batch_id: int = EMPTY_EVENT_ID
     cancel_request_id: str = ""
+    # target coordinates (from the initiated event) — task refresh must
+    # be able to regenerate a full CancelExecution transfer task
+    target_domain_id: str = ""
+    target_workflow_id: str = ""
+    target_run_id: str = ""
+    target_child_workflow_only: bool = False
 
 
 @dataclasses.dataclass
@@ -198,6 +207,11 @@ class SignalInfo:
     signal_name: str = ""
     input: bytes = b""
     control: bytes = b""
+    # target coordinates (from the initiated event) — see RequestCancelInfo
+    target_domain_id: str = ""
+    target_workflow_id: str = ""
+    target_run_id: str = ""
+    target_child_workflow_only: bool = False
 
 
 @dataclasses.dataclass
@@ -433,6 +447,10 @@ class MutableState:
             ei.parent_run_id = a.get("parent_run_id", "")
         ei.initiated_id = a.get("parent_initiated_event_id", EMPTY_EVENT_ID)
         ei.attempt = a.get("attempt", 0)
+        backoff_s = a.get("first_decision_task_backoff_seconds", 0) or 0
+        ei.first_decision_backoff_deadline = (
+            event.timestamp + backoff_s * 1_000_000_000 if backoff_s else 0
+        )
         if a.get("expiration_timestamp", 0):
             ei.expiration_time = a["expiration_timestamp"]
         rp = RetryPolicy.from_dict(a.get("retry_policy"))

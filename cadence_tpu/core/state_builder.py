@@ -280,6 +280,12 @@ class StateBuilder:
                 rci = ms.replicate_request_cancel_external_initiated_event(
                     first_event.event_id, event, self.id_generator()
                 )
+                rci.target_domain_id = self.domain_resolver(
+                    a.get("domain", ""))
+                rci.target_workflow_id = a.get("workflow_id", "")
+                rci.target_run_id = a.get("run_id", "")
+                rci.target_child_workflow_only = a.get(
+                    "child_workflow_only", False)
                 self.transfer_tasks.append(
                     T.cancel_external_transfer_task(
                         self.domain_resolver(a.get("domain", "")),
@@ -301,6 +307,12 @@ class StateBuilder:
                 si = ms.replicate_signal_external_initiated_event(
                     first_event.event_id, event, self.id_generator()
                 )
+                si.target_domain_id = self.domain_resolver(
+                    a.get("domain", ""))
+                si.target_workflow_id = a.get("workflow_id", "")
+                si.target_run_id = a.get("run_id", "")
+                si.target_child_workflow_only = a.get(
+                    "child_workflow_only", False)
                 self.transfer_tasks.append(
                     T.signal_external_transfer_task(
                         self.domain_resolver(a.get("domain", "")),

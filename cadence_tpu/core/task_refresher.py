@@ -33,13 +33,36 @@ def refresh_tasks(ms: MutableState) -> Tuple[List[T.TransferTask], List[T.TimerT
         transfer.append(T.close_execution_transfer_task())
         return transfer, timer
 
-    # workflow timeout (refreshTasksForWorkflowStart)
+    # workflow timeout (refreshTasksForWorkflowStart); a pending
+    # first-decision backoff extends the window exactly as the
+    # StateBuilder does at start
+    backoff_extra = 0
+    if ei.first_decision_backoff_deadline:
+        backoff_extra = max(
+            0, ei.first_decision_backoff_deadline - ei.start_timestamp
+        )
     timer.append(
         T.TimerTask(
             task_type=TimerTaskType.WorkflowTimeout,
-            visibility_timestamp=ei.start_timestamp + ei.workflow_timeout * SECOND,
+            visibility_timestamp=ei.start_timestamp
+            + ei.workflow_timeout * SECOND + backoff_extra,
         )
     )
+    # cron/retry runs waiting on their first decision re-arm the
+    # backoff timer (refreshTasksForWorkflowStart delayed-decision
+    # branch); without it a rebuilt/staged run never schedules its
+    # first decision after failover
+    if (
+        ei.first_decision_backoff_deadline
+        and not ms.has_pending_decision()
+        and ei.last_processed_event < 1
+    ):
+        timer.append(
+            T.TimerTask(
+                task_type=TimerTaskType.WorkflowBackoffTimer,
+                visibility_timestamp=ei.first_decision_backoff_deadline,
+            )
+        )
 
     # decision (refreshTasksForDecision)
     if ms.has_pending_decision():
@@ -86,15 +109,25 @@ def refresh_tasks(ms: MutableState) -> Tuple[List[T.TransferTask], List[T.TimerT
                 T.start_child_transfer_task(ci.domain_name, ci.started_workflow_id, cid)
             )
     for rid in sorted(ms.pending_request_cancels):
+        rc = ms.pending_request_cancels[rid]
         transfer.append(
-            T.TransferTask(
-                task_type=TransferTaskType.CancelExecution, initiated_id=rid
+            T.cancel_external_transfer_task(
+                rc.target_domain_id or ei.domain_id,
+                rc.target_workflow_id,
+                rc.target_run_id,
+                rc.target_child_workflow_only,
+                rid,
             )
         )
     for sid in sorted(ms.pending_signals):
+        sg = ms.pending_signals[sid]
         transfer.append(
-            T.TransferTask(
-                task_type=TransferTaskType.SignalExecution, initiated_id=sid
+            T.signal_external_transfer_task(
+                sg.target_domain_id or ei.domain_id,
+                sg.target_workflow_id,
+                sg.target_run_id,
+                sg.target_child_workflow_only,
+                sid,
             )
         )
     return transfer, timer

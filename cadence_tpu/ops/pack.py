@@ -72,6 +72,12 @@ class WorkflowSideTable:
     # derived here at pack time so device rebuilds agree with the host
     # oracle's replicate path (mutable_state MAX_RESET_POINTS cap)
     auto_reset_points: List[Dict] = dataclasses.field(default_factory=list)
+    # first-decision backoff deadline (ns) for cron/retry continued runs
+    first_decision_backoff_deadline: int = 0
+    # slot → (domain, workflow_id, run_id, child_only) for pending
+    # external cancels/signals: the task refresher needs full targets
+    cancel_targets: Dict[int, tuple] = dataclasses.field(default_factory=dict)
+    signal_targets: Dict[int, tuple] = dataclasses.field(default_factory=dict)
     # slot → strings
     activity_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
     activity_task_lists: Dict[int, str] = dataclasses.field(default_factory=dict)
@@ -271,6 +277,11 @@ def pack_workflow(
                 side.task_list = a.get("task_list", "")
                 side.workflow_type = a.get("workflow_type", "")
                 side.cron_schedule = a.get("cron_schedule", "")
+                backoff_s = a.get(
+                    "first_decision_task_backoff_seconds", 0) or 0
+                side.first_decision_backoff_deadline = (
+                    ev.timestamp + backoff_s * SECONDS if backoff_s else 0
+                )
                 side.parent_domain = a.get("parent_workflow_domain") or ""
                 side.parent_workflow_id = a.get("parent_workflow_id") or ""
                 side.parent_run_id = a.get("parent_run_id") or ""
@@ -424,6 +435,11 @@ def pack_workflow(
 
             elif et == EventType.RequestCancelExternalWorkflowExecutionInitiated:
                 slot = cancels.alloc(ev.event_id)
+                side.cancel_targets[slot] = (
+                    a.get("domain", ""), a.get("workflow_id", ""),
+                    a.get("run_id", ""),
+                    bool(a.get("child_workflow_only", False)),
+                )
 
             elif et in (
                 EventType.RequestCancelExternalWorkflowExecutionFailed,
@@ -435,6 +451,11 @@ def pack_workflow(
 
             elif et == EventType.SignalExternalWorkflowExecutionInitiated:
                 slot = signals.alloc(ev.event_id)
+                side.signal_targets[slot] = (
+                    a.get("domain", ""), a.get("workflow_id", ""),
+                    a.get("run_id", ""),
+                    bool(a.get("child_workflow_only", False)),
+                )
 
             elif et in (
                 EventType.SignalExternalWorkflowExecutionFailed,

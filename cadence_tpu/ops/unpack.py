@@ -281,6 +281,9 @@ def state_row_to_mutable_state(
     ei.memo = dict(side.memo)
     ei.search_attributes = dict(side.search_attributes)
     ei.auto_reset_points = [dict(p) for p in side.auto_reset_points]
+    ei.first_decision_backoff_deadline = (
+        side.first_decision_backoff_deadline
+    )
     ei.state = WorkflowState(int(ex[S.X_STATE]))
     ei.close_status = CloseStatus(int(ex[S.X_CLOSE_STATUS]))
     ei.next_event_id = int(ex[S.X_NEXT_EVENT_ID])
@@ -362,21 +365,31 @@ def state_row_to_mutable_state(
         )
         ms.pending_children[ci.initiated_id] = ci
 
-    for row in np.asarray(state.cancels[b]):
+    for slot, row in enumerate(np.asarray(state.cancels[b])):
         if row[S.RC_OCC]:
+            tgt = side.cancel_targets.get(slot) or ("", "", "", False)
             rc = RequestCancelInfo(
                 version=int(row[S.RC_VERSION]),
                 initiated_id=int(row[S.RC_INITIATED_ID]),
                 initiated_event_batch_id=int(row[S.RC_INITIATED_BATCH_ID]),
+                target_domain_id=tgt[0],
+                target_workflow_id=tgt[1],
+                target_run_id=tgt[2],
+                target_child_workflow_only=tgt[3],
             )
             ms.pending_request_cancels[rc.initiated_id] = rc
 
-    for row in np.asarray(state.signals[b]):
+    for slot, row in enumerate(np.asarray(state.signals[b])):
         if row[S.SG_OCC]:
+            tgt = side.signal_targets.get(slot) or ("", "", "", False)
             si = SignalInfo(
                 version=int(row[S.SG_VERSION]),
                 initiated_id=int(row[S.SG_INITIATED_ID]),
                 initiated_event_batch_id=int(row[S.SG_INITIATED_BATCH_ID]),
+                target_domain_id=tgt[0],
+                target_workflow_id=tgt[1],
+                target_run_id=tgt[2],
+                target_child_workflow_only=tgt[3],
             )
             ms.pending_signals[si.initiated_id] = si
 
