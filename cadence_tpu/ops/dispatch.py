@@ -146,9 +146,11 @@ class DeviceDispatcher:
                         state0, events, self.caps
                     )
                 else:
-                    from .replay import replay_scan
+                    from .replay import replay_scan_jit
 
-                    final = replay_scan(state0, events)
+                    # the jitted form donates state0's buffer and skips
+                    # per-batch retracing on this hot storm-drain path
+                    final = replay_scan_jit(state0, events)
                 # async dispatch: the call returns while the device
                 # works; the next H2D/pack proceeds immediately
                 self._out.put((batch_id, packed, final))

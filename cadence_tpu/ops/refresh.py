@@ -46,6 +46,13 @@ class RefreshedTasks:
     child_transfer: Any          # [B, C] initiated_id or -1
     cancel_transfer: Any         # [B, RC] initiated_id or -1
     signal_transfer: Any         # [B, SG] initiated_id or -1
+    # [B] bool: running, no pending decision, first decision not yet
+    # processed — hydrate applies the side table's backoff deadline to
+    # re-arm the WorkflowBackoffTimer (host twin: task_refresher)
+    first_decision_pending: Any = None
+    # [B] relative start ts (device encoding) — hydrate computes the
+    # backoff extension of the timeout window from it
+    start_ts: Any = None
 
     def tree_flatten(self):
         return (
@@ -54,6 +61,7 @@ class RefreshedTasks:
                 self.decision_transfer, self.decision_timer,
                 self.activity_transfer, self.activity_timer, self.user_timer,
                 self.child_transfer, self.cancel_transfer, self.signal_transfer,
+                self.first_decision_pending, self.start_ts,
             ),
             None,
         )
@@ -202,6 +210,11 @@ def refresh_tasks_device(state: S.StateTensors) -> RefreshedTasks:
         sg[:, :, S.SG_INITIATED_ID], neg1,
     )
 
+    first_decision_pending = (
+        running
+        & (ex[:, S.X_DEC_SCHEDULE_ID] == EMPTY_EVENT_ID)
+        & (ex[:, S.X_LAST_PROCESSED_EVENT] < 1)
+    )
     return RefreshedTasks(
         close_transfer=close_transfer,
         workflow_timeout_ts=workflow_timeout_ts,
@@ -213,6 +226,8 @@ def refresh_tasks_device(state: S.StateTensors) -> RefreshedTasks:
         child_transfer=child_transfer,
         cancel_transfer=cancel_transfer,
         signal_transfer=signal_transfer,
+        first_decision_pending=first_decision_pending,
+        start_ts=ex[:, S.X_START_TS],
     )
 
 
@@ -246,10 +261,28 @@ def hydrate_tasks(
         transfer.append(T.close_execution_transfer_task())
         return transfer, timer
 
+    # a pending first-decision backoff extends the timeout window and
+    # re-arms the backoff timer, exactly like the host twin
+    # (core/task_refresher.py)
+    deadline = side.first_decision_backoff_deadline
+    backoff_extra = 0
+    if deadline and r.start_ts is not None:
+        start_ns = vis_ns(int(np.asarray(r.start_ts)[b]))
+        backoff_extra = max(0, deadline - start_ns)
     timer.append(T.TimerTask(
         task_type=TimerTaskType.WorkflowTimeout,
-        visibility_timestamp=vis_ns(int(r.workflow_timeout_ts[b])),
+        visibility_timestamp=vis_ns(int(r.workflow_timeout_ts[b]))
+        + backoff_extra,
     ))
+    if (
+        deadline
+        and r.first_decision_pending is not None
+        and bool(np.asarray(r.first_decision_pending)[b])
+    ):
+        timer.append(T.TimerTask(
+            task_type=TimerTaskType.WorkflowBackoffTimer,
+            visibility_timestamp=deadline,
+        ))
     if r.decision_transfer[b] != -1:
         transfer.append(T.decision_transfer_task(
             domain_id, side.task_list, int(r.decision_transfer[b])
@@ -299,11 +332,19 @@ def hydrate_tasks(
             side.child_workflow_ids.get(slot, ""), init,
         ))
     for init in sorted(int(x) for x in r.cancel_transfer[b] if x != -1):
-        transfer.append(T.TransferTask(
-            task_type=TransferTaskType.CancelExecution, initiated_id=init
+        slot = next(
+            s for s, x in enumerate(r.cancel_transfer[b]) if int(x) == init
+        )
+        tgt = side.cancel_targets.get(slot) or ("", "", "", False)
+        transfer.append(T.cancel_external_transfer_task(
+            tgt[0] or domain_id, tgt[1], tgt[2], tgt[3], init,
         ))
     for init in sorted(int(x) for x in r.signal_transfer[b] if x != -1):
-        transfer.append(T.TransferTask(
-            task_type=TransferTaskType.SignalExecution, initiated_id=init
+        slot = next(
+            s for s, x in enumerate(r.signal_transfer[b]) if int(x) == init
+        )
+        tgt = side.signal_targets.get(slot) or ("", "", "", False)
+        transfer.append(T.signal_external_transfer_task(
+            tgt[0] or domain_id, tgt[1], tgt[2], tgt[3], init,
         ))
     return transfer, timer

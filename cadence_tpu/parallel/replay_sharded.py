@@ -119,9 +119,11 @@ def _ndc_exchange_fn(mesh: Mesh):
         all_vh = jax.lax.all_gather(vh_items, SHARD_AXIS, tiled=True)
         all_vh_len = jax.lax.all_gather(vh_len, SHARD_AXIS, tiled=True)
         # global counters: replayed workflows + max failover version — the
-        # cluster-metadata aggregate the replication storm needs
+        # cluster-metadata aggregate the replication storm needs. A row
+        # is REPLAYED iff its history actually started (start_ts set):
+        # X_STATE >= 0 is true for zero-initialized padding rows too
         replayed = jax.lax.psum(
-            jnp.sum(exec_info[:, S.X_STATE] >= 0), SHARD_AXIS
+            jnp.sum(exec_info[:, S.X_START_TS] > 0), SHARD_AXIS
         )
         max_version = jax.lax.pmax(
             jnp.max(exec_info[:, S.X_CUR_VERSION]), SHARD_AXIS
