@@ -167,6 +167,9 @@ class FilestoreVisibilityArchiver(VisibilityArchiver):
                         search_attributes=p.get("search_attributes", {}),
                     )
                 )
+        if page_size <= 0:
+            page_size = 100  # see AdvancedVisibilityStore: a zero page
+            # would return the same token forever
         matched = compile_query(query).apply(records)
         page = matched[next_token : next_token + page_size]
         token = next_token + len(page)

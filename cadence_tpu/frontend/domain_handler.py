@@ -51,6 +51,16 @@ def _next_archival_state(
     the URI is write-once; enabling requires a URI; disable keeps it."""
     if req_uri and uri and req_uri != uri:
         raise BadRequestError("archival URI is immutable once set")
+    if req_uri and not uri:
+        # validate at SET time — the URI is write-once, so a typo
+        # accepted here permanently breaks the domain's archival
+        from cadence_tpu.archival import ArchiverProvider, URI
+
+        try:
+            parsed = URI.parse(req_uri)
+            ArchiverProvider.default().get_history_archiver(parsed.scheme)
+        except Exception as e:
+            raise BadRequestError(f"invalid archival URI {req_uri!r}: {e}")
     new_uri = uri or req_uri
     if req_status is None:
         return status, new_uri
@@ -335,13 +345,39 @@ class DomainHandler:
         except EntityNotExistsError:
             self.metadata.create_domain(rec)
             return
-        # last-writer-wins on (failover_version, config_version)
+        # PER-FIELD merge (reference domainReplicationTaskExecutor):
+        # failover state and config state version independently — a
+        # pure failover published by a cluster that hasn't seen the
+        # latest config update must still land (an OR-reject would
+        # silently drop it and the clusters would diverge on the
+        # active cluster forever)
         if (
-            rec.failover_version < existing.failover_version
-            or rec.config_version < existing.config_version
+            rec.failover_version <= existing.failover_version
+            and rec.config_version <= existing.config_version
         ):
             return
-        self.metadata.update_domain(rec)
+        merged = rec
+        if rec.config_version < existing.config_version:
+            # keep the newer local config, take the newer failover
+            merged = dataclasses.replace(
+                existing,
+                replication_config=rec.replication_config,
+                failover_version=rec.failover_version,
+                failover_notification_version=(
+                    rec.failover_notification_version
+                ),
+            )
+        elif rec.failover_version < existing.failover_version:
+            # keep the newer local failover, take the newer config
+            merged = dataclasses.replace(
+                rec,
+                replication_config=existing.replication_config,
+                failover_version=existing.failover_version,
+                failover_notification_version=(
+                    existing.failover_notification_version
+                ),
+            )
+        self.metadata.update_domain(merged)
 
 
 def _record_to_dict(rec: DomainRecord) -> Dict[str, Any]:

@@ -664,7 +664,14 @@ class WorkflowHandler:
     ) -> int:
         domain_id = self._check(domain, **headers)
         vis = self._vis()
-        if query and hasattr(vis, "count_workflow_executions_by_query"):
+        if query:
+            if not hasattr(vis, "count_workflow_executions_by_query"):
+                # answering the TOTAL count for a filtered query would
+                # be a silently wrong answer
+                raise BadRequestError(
+                    "advanced visibility is not configured; "
+                    "count with a query is unavailable"
+                )
             return vis.count_workflow_executions_by_query(domain_id, query)
         return vis.count_workflow_executions(domain_id)
 

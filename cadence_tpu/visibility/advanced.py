@@ -69,6 +69,9 @@ class AdvancedVisibilityStore(VisibilityManager):
         page_size: int = 100,
         next_token: int = 0,
     ) -> Tuple[List[VisibilityRecord], int]:
+        if page_size <= 0:
+            page_size = 100  # a non-positive size would loop the
+            # caller forever on the same token with empty pages
         compiled = compile_query(query)
         matched = compiled.apply(self._all_records(domain_id))
         if not compiled.order_field:

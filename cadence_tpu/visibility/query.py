@@ -22,11 +22,13 @@ import dataclasses
 import re
 from typing import Any, Callable, List, Optional, Tuple
 
+from cadence_tpu.runtime.api import BadRequestError
 from cadence_tpu.runtime.persistence.records import VisibilityRecord
 
 
-class QueryError(Exception):
-    pass
+class QueryError(BadRequestError):
+    """Malformed visibility query — a CLIENT error (maps to
+    INVALID_ARGUMENT over RPC), never an internal fault."""
 
 
 _TOKEN_RE = re.compile(
@@ -178,9 +180,16 @@ class _Parser:
             _, high = self.expect("value")
             low = _coerce(field, low)
             high = _coerce(field, high)
-            return lambda r: (
-                (v := get(r)) is not None and low <= v <= high
-            )
+            def between(r, low=low, high=high):
+                v = get(r)
+                if v is None:
+                    return False
+                try:
+                    return low <= v <= high
+                except TypeError:
+                    return False  # type-mismatched literal: no match
+
+            return between
         if tok == ("kw", "IN"):
             self.expect("lparen")
             values = []
@@ -247,8 +256,14 @@ class VisibilityQuery:
         out = [r for r in records if self.predicate(r)]
         if self.order_field:
             get = _field_getter(self.order_field)
+            # type-stable key: mixed-typed search-attribute values must
+            # not blow up list.sort with a str-vs-int comparison
             out.sort(
-                key=lambda r: (get(r) is None, get(r)),
+                key=lambda r: (
+                    get(r) is None,
+                    type(get(r)).__name__,
+                    get(r) if get(r) is not None else 0,
+                ),
                 reverse=self.order_desc,
             )
         return out
