@@ -43,12 +43,13 @@ class HistoryClient:
     def _engine_for(self, workflow_id: str):
         last_err = None
         for attempt in range(_OWNERSHIP_RETRY):
+            if attempt:
+                time.sleep(_OWNERSHIP_BACKOFF_S * attempt)
             for controller in self._controllers.values():
                 try:
                     return controller.get_engine(workflow_id)
                 except ShardOwnershipLostError as e:
                     last_err = e
-            time.sleep(_OWNERSHIP_BACKOFF_S * (attempt + 1))
         raise last_err or ShardOwnershipLostError(-1, "<unknown>")
 
     def _call(self, workflow_id: str, method: str, *args, **kwargs):

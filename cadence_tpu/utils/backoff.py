@@ -76,9 +76,23 @@ def next_backoff_interval_seconds(
         return NO_INTERVAL
     if error_reason and error_reason in tuple(policy.non_retriable_errors):
         return NO_INTERVAL
-    interval = policy.initial_interval_seconds * (
-        policy.backoff_coefficient ** attempt
-    )
+    # guard the exponentiation: coefficient ** attempt overflows a
+    # float near attempt ~1000, crashing the retry path instead of
+    # returning the capped interval. Exact power below the guard so
+    # small intervals stay bit-exact (2.0**3 == 8, not exp-log 7.999…)
+    import math
+
+    if policy.backoff_coefficient <= 1.0:
+        interval = float(policy.initial_interval_seconds)
+    elif (
+        math.log(policy.initial_interval_seconds)
+        + attempt * math.log(policy.backoff_coefficient)
+    ) > 30:  # e^30 s ≈ 340k years — beyond any cap or expiration
+        interval = float(1 << 40)
+    else:
+        interval = policy.initial_interval_seconds * (
+            policy.backoff_coefficient ** attempt
+        )
     if policy.maximum_interval_seconds:
         interval = min(interval, policy.maximum_interval_seconds)
     interval = int(interval)

@@ -72,12 +72,25 @@ class FileBasedClient(Client):
             return
         if mtime == self._mtime:
             return
-        with open(self.path) as f:
-            raw = json.load(f)
-        values = {
-            key: [(dict(e.get("filters", {})), e["value"]) for e in entries]
-            for key, entries in raw.items()
-        }
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            values = {
+                key: [
+                    (dict(e.get("filters", {})), e["value"])
+                    for e in entries
+                ]
+                for key, entries in raw.items()
+            }
+        except Exception:
+            # malformed / partially-written file: keep serving the last
+            # good snapshot (reference fileBasedClient behavior)
+            from cadence_tpu.utils.log import get_logger
+
+            get_logger("cadence_tpu.dynamicconfig").exception(
+                f"failed to load {self.path}; keeping previous values"
+            )
+            return
         with self._lock:
             self._values = values
             self._mtime = mtime
@@ -95,12 +108,17 @@ class FileBasedClient(Client):
 def _best_match(
     entries: List[Tuple[Dict[str, Any], Any]], filters: Dict[str, Any]
 ) -> Optional[Any]:
-    best, best_n = None, -1
+    """Most-specific match wins: domain+tasklist > domain > tasklist >
+    unfiltered; equal specificity resolves to the LAST entry so a
+    later set_value overrides an earlier one."""
+    best, best_score = None, -1
     for entry_filters, value in entries:
         if all(filters.get(k) == v for k, v in entry_filters.items()):
-            n = len(entry_filters)
-            if n > best_n:
-                best, best_n = value, n
+            score = 2 * ("domain" in entry_filters) + (
+                "task_list" in entry_filters
+            ) + len(entry_filters)
+            if score >= best_score:
+                best, best_score = value, score
     return best
 
 

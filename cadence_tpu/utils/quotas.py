@@ -25,6 +25,12 @@ class TokenBucket:
         self._clock = clock
         self._lock = threading.Lock()
 
+    def set_rate(self, rps: float) -> None:
+        with self._lock:
+            self.rps = float(rps)
+            self.burst = max(1, int(rps))
+            self._tokens = min(self._tokens, float(self.burst))
+
     def allow(self, n: int = 1) -> bool:
         with self._lock:
             now = self._clock()
@@ -54,13 +60,19 @@ class MultiStageRateLimiter:
         self._lock = threading.Lock()
 
     def allow(self, domain: str = "") -> bool:
-        if not self._global.allow():
-            return False
-        if not domain:
-            return True
-        with self._lock:
-            bucket = self._domains.get(domain)
-            if bucket is None:
-                bucket = TokenBucket(self._domain_rps(domain), clock=self._clock)
-                self._domains[domain] = bucket
-        return bucket.allow()
+        # DOMAIN bucket first (reference multiStageRateLimiter): a
+        # throttled domain must not drain the global budget and starve
+        # compliant domains
+        if domain:
+            rps = self._domain_rps(domain)
+            with self._lock:
+                bucket = self._domains.get(domain)
+                if bucket is None:
+                    bucket = TokenBucket(rps, clock=self._clock)
+                    self._domains[domain] = bucket
+                elif bucket.rps != rps:
+                    # dynamic-config changes take effect live
+                    bucket.set_rate(rps)
+            if not bucket.allow():
+                return False
+        return self._global.allow()
