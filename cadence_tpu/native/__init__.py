@@ -39,28 +39,46 @@ def _build() -> Optional[str]:
         os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
     ):
         return _LIB_PATH
+    # compile to a temp path and rename atomically: a killed compile or
+    # two processes racing must never leave a half-written .so that
+    # every later process accepts (fresh mtime) and fails to dlopen
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             "-o", _LIB_PATH, _SRC],
+             "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _LIB_PATH)
         return _LIB_PATH
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 
+_load_failed = False
+
+
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, HAVE_NATIVE
+    global _lib, HAVE_NATIVE, _load_failed
     with _lock:
         if _lib is not None:
             return _lib
+        if _load_failed:
+            # don't re-run a 120s compile attempt on EVERY call while
+            # holding the module lock; the fallback path serves
+            return None
         path = _build()
         if path is None:
+            _load_failed = True
             return None
         try:
             lib = ctypes.CDLL(path)
         except OSError:
+            _load_failed = True
             return None
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)

@@ -367,16 +367,26 @@ void ct_replay_sequential(
             ex[X_NEXT_EVENT_ID] = ev_id + 1;
             ex[X_LAST_FIRST_EVENT_ID] = batch_first;
 
-            // version-history AddOrUpdateItem
+            // version-history AddOrUpdateItem. Mirrors the XLA kernel
+            // exactly when vh_len outgrows cap_v: the READ clamps to
+            // cap-1 (jnp.take_along_axis) and a same-branch write at
+            // an index >= cap is dropped (the kernel's arange mask) —
+            // the unclamped original indexed past this workflow's
+            // window (cross-row corruption / heap write at b = B-1)
             {
                 const int32_t len = vh_len[b];
-                const int32_t last_idx = len > 0 ? len - 1 : 0;
-                const bool same = len > 0 && vh[last_idx * 2 + 1] == version;
                 const int32_t cap = (int32_t)cap_v;
+                const int32_t last_idx = len > 0 ? len - 1 : 0;
+                const int32_t read_idx =
+                    last_idx < cap ? last_idx : cap - 1;
+                const bool same =
+                    len > 0 && vh[read_idx * 2 + 1] == version;
                 const int32_t wi =
                     same ? last_idx : (len < cap - 1 ? len : cap - 1);
-                vh[wi * 2] = ev_id;
-                vh[wi * 2 + 1] = version;
+                if (wi < cap) {
+                    vh[wi * 2] = ev_id;
+                    vh[wi * 2 + 1] = version;
+                }
                 if (!same) vh_len[b] = len + 1;
             }
 
