@@ -50,16 +50,84 @@ from __future__ import annotations
 import json
 import os
 import random
+import subprocess
 import sys
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+# BENCH_SMOKE=1: tiny shapes for CI coverage of the harness itself
+# (tests/test_bench_smoke.py) — minutes -> seconds, CPU-safe.
+SMOKE = os.environ.get("BENCH_SMOKE") == "1"
+
 if "--cpu" in sys.argv:
     # the axon plugin bootstrap rewrites JAX_PLATFORMS; pin via config
     jax.config.update("jax_platforms", "cpu")
+
+
+def _probe_backend(timeout_s: float = 120.0):
+    """Touch the backend in a *subprocess* with a hard timeout.
+
+    On this platform the tunnel can wedge so that ``jax.devices()`` hangs
+    forever in a retry loop (never raises) — probing in-process would
+    turn a dead tunnel into a dead benchmark. Returns the platform name
+    ("tpu"/"cpu"/...) or None if the probe failed or timed out.
+    """
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    return plat or None
+
+
+# ---- single-print guarantee + wall-clock watchdog -----------------------
+# The driver records stdout; whatever happens (tunnel death mid-run,
+# unbounded compile, crash) exactly one parseable JSON line must appear.
+_PRINT_LOCK = threading.Lock()
+_PRINTED = False
+_PARTIAL: dict = {}
+
+
+def _emit(obj) -> None:
+    global _PRINTED
+    with _PRINT_LOCK:
+        if _PRINTED:
+            return
+        _PRINTED = True
+        print(json.dumps(obj), flush=True)
+
+
+def _fail_record(error: str) -> dict:
+    """Shared shape for any non-success record (driver parses these keys)."""
+    head = _PARTIAL.get("retry_deep") or {}
+    return {
+        "metric": "histories_replayed_per_sec_at_1k_depth",
+        "value": head.get("histories_per_sec", 0),
+        "unit": "histories/s",
+        "vs_baseline": head.get("vs_baseline", 0),
+        "error": error,
+        "configs": dict(_PARTIAL),
+    }
+
+
+def _watchdog(wall_s: float) -> None:
+    def fire():
+        _emit(_fail_record(
+            f"wall-clock watchdog fired after {wall_s:.0f}s "
+            "(backend hung or compile unbounded)"))
+        os._exit(0)
+    t = threading.Timer(wall_s, fire)
+    t.daemon = True
+    t.start()
 
 # persistent compile cache: the deep-scan kernels take minutes to
 # compile on this host; cached binaries make reruns start in seconds
@@ -76,6 +144,8 @@ def _build_histories(config: str, n_unique: int, caps):
 
     rng = random.Random(42)
     fz = HistoryFuzzer(seed=42, caps=caps)
+    retry_depth, timer_depth, ndc_depth = (
+        (40, 40, 40) if SMOKE else (1000, 400, 1000))
     out = []
     for i in range(n_unique):
         if config == "echo":
@@ -83,11 +153,11 @@ def _build_histories(config: str, n_unique: int, caps):
         elif config == "signal":
             b = W.signal_history(rng)
         elif config == "timer_storm":
-            b = W.timer_storm_history(rng, depth=400)
+            b = W.timer_storm_history(rng, depth=timer_depth)
         elif config == "retry_deep":
-            b = W.retry_deep_history(rng, depth=1000)
+            b = W.retry_deep_history(rng, depth=retry_depth)
         else:  # ndc_storm
-            b = W.ndc_storm_history(fz, depth=1000)
+            b = W.ndc_storm_history(fz, depth=ndc_depth)
         out.append((f"wf-{i}", f"run-{i}", b))
     return out
 
@@ -256,8 +326,21 @@ def main() -> None:
     from cadence_tpu.ops import schema as S
 
     if native._load() is None:
-        print(json.dumps({"error": "native baseline unavailable (no g++)"}))
+        _emit(_fail_record("native baseline unavailable (no g++)"))
         return
+
+    wall_s = float(os.environ.get("BENCH_WALL_S", "2100"))
+    _watchdog(wall_s)
+
+    backend_note = None
+    if "--cpu" not in sys.argv and not SMOKE:
+        plat = _probe_backend(float(os.environ.get("BENCH_PROBE_S", "120")))
+        if plat is None:
+            # tunnel dead/wedged: a flagged CPU run beats an empty record
+            jax.config.update("jax_platforms", "cpu")
+            backend_note = "backend probe failed or timed out; CPU fallback"
+    elif SMOKE:
+        jax.config.update("jax_platforms", "cpu")
 
     on_cpu = jax.default_backend() == "cpu"
     # the Pallas kernel needs the real chip; interpret mode is a test
@@ -266,6 +349,8 @@ def main() -> None:
     scale = 1 if on_cpu else 128
     iters = 3 if on_cpu else 5
     bt, tb = 8192, 16
+    if SMOKE:
+        scale, iters = 1, 1
 
     # per-config capacities: sized to the workload (slot tables directly
     # set HBM bytes/step for the XLA kernel and VMEM rows for Pallas)
@@ -295,19 +380,32 @@ def main() -> None:
             batch=256 * scale, baseline=256),
     }
 
+    if SMOKE:
+        # harness-coverage shapes: one config, tiny tensors, seconds on CPU
+        CONFIGS = {"retry_deep": dict(
+            caps=S.Capacities(max_events=64, max_activities=4, max_timers=2,
+                              max_children=2, max_request_cancels=2,
+                              max_signals_ext=2, max_version_items=2),
+            batch=32, baseline=32)}
+
     copy_bw = measure_copy_bw_gbps() if not on_cpu else None
 
     # headline first; if the wall-clock budget runs out (cold compile
     # cache), the JSON line still carries the metric that matters and
     # marks the rest skipped
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    # never *start* a non-headline config that could straddle the
+    # watchdog wall: a cold-compile config can eat the whole slack and
+    # turn an otherwise-healthy run into an error record
+    wall_margin_s = 480.0
     order = ["retry_deep"] + [k for k in CONFIGS if k != "retry_deep"]
     t_start = time.perf_counter()
-    results = {}
+    results = _PARTIAL
     for config in order:
         cfg = CONFIGS[config]
+        elapsed = time.perf_counter() - t_start
         if config != "retry_deep" and (
-            time.perf_counter() - t_start > budget_s
+            elapsed > budget_s or elapsed > wall_s - wall_margin_s
         ):
             results[config] = {"skipped": "bench budget exhausted"}
             continue
@@ -325,12 +423,21 @@ def main() -> None:
         "kernel": head["kernel"],
         "batch_rebuild_ms_per_1k_history": round(
             head["batch_rebuild_ms"] / head["batch"], 4),
+        "on_cpu": on_cpu,
         "configs": results,
     }
+    if backend_note:
+        out["backend_note"] = backend_note
+    if SMOKE:
+        out["smoke"] = True
     if copy_bw is not None:
         out["copy_bw_gbps"] = round(copy_bw, 1)
-    print(json.dumps(out))
+    _emit(out)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as exc:  # the record must exist no matter what
+        _emit(_fail_record(f"{type(exc).__name__}: {str(exc)[:300]}"))
+        raise SystemExit(0)

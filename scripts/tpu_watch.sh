@@ -1,0 +1,37 @@
+#!/bin/sh
+# Tunnel watcher: probe the axon TPU tunnel until it grants a device,
+# then run the one-shot measurement session (scripts/tpu_session.sh) and
+# exit. The tunnel has historically been up for short windows — this
+# watcher exists so no window is missed while CPU work proceeds.
+#
+# Discipline (see memory: never two TPU clients at once):
+#   - exactly one probe process at a time, killed hard on timeout
+#   - session runs sequentially after a successful probe, then we exit
+#   - stop switch: touch /tmp/tpu_watch.stop
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_watch.log
+OUT=scripts/out
+mkdir -p "$OUT"
+DEADLINE=$(( $(date +%s) + 37800 ))   # give up after 10.5h
+
+echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if [ -e /tmp/tpu_watch.stop ]; then
+        echo "$(date -u +%FT%TZ) stop switch, exiting" >> "$LOG"
+        exit 0
+    fi
+    if timeout -k 15 90 python -c \
+        "import jax; d=jax.devices(); assert d and d[0].platform!='cpu', d; print(d)" \
+        >> "$LOG" 2>&1; then
+        echo "$(date -u +%FT%TZ) TUNNEL UP -> running session" >> "$LOG"
+        sh scripts/tpu_session.sh > "$OUT/tpu_session_r5.log" 2>&1
+        rc=$?
+        echo "$(date -u +%FT%TZ) session done rc=$rc" >> "$LOG"
+        exit $rc
+    fi
+    echo "$(date -u +%FT%TZ) no grant" >> "$LOG"
+    sleep 120
+done
+echo "$(date -u +%FT%TZ) deadline reached, exiting" >> "$LOG"
+exit 1

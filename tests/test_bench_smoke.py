@@ -1,0 +1,44 @@
+"""CI coverage for bench.py itself (VERDICT r4 weak #1).
+
+The driver records bench.py's stdout as the round's perf record; round 4
+lost its record because the harness crashed on a dead tunnel. These
+tests pin the contract: *any* invocation exits 0 and prints exactly one
+parseable JSON line carrying the metric keys."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra):
+    env = dict(os.environ)
+    env.update(env_extra)
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, cwd=REPO, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    return json.loads(lines[0])
+
+
+def test_smoke_emits_one_json_record():
+    out = _run({"BENCH_SMOKE": "1"})
+    for key in ("metric", "value", "unit", "vs_baseline", "configs"):
+        assert key in out, out
+    assert out["metric"] == "histories_replayed_per_sec_at_1k_depth"
+    assert out["smoke"] is True and out["on_cpu"] is True
+    head = out["configs"]["retry_deep"]
+    assert head["histories_per_sec"] > 0
+    assert head["baseline_cpp_per_sec"] > 0
+
+
+def test_watchdog_still_yields_parseable_record():
+    # wall budget so small the watchdog fires mid-run: the record must
+    # still be one JSON line with the metric keys and an error field
+    out = _run({"BENCH_SMOKE": "1", "BENCH_WALL_S": "0.01"})
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, out
+    assert "error" in out
