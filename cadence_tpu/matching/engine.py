@@ -73,6 +73,7 @@ class MatchingEngine:
         instrument_methods(self, self.metrics, MATCHING_OPS)
         self._lock = threading.Lock()
         self._managers: Dict[tuple, TaskListManager] = {}
+        self._creating: Dict[tuple, threading.Lock] = {}
         self._pollers: Dict[tuple, PollerHistory] = {}
         cfg = config or Collection()
         self._n_write_partitions = cfg.int_property(
@@ -92,31 +93,41 @@ class MatchingEngine:
         key = tl_id.key()
         with self._lock:
             mgr = self._managers.get(key)
-        if mgr is not None:
-            return mgr
-        # construct OUTSIDE the engine lock: TaskListManager leases from
-        # the store (blocking I/O) and starts threads — holding the lock
-        # across that would stall every other task list's traffic
-        forwarder = Forwarder(tl_id, self)
-        matcher = TaskMatcher(
-            forward_offer=(
-                forwarder.forward_offer if forwarder.enabled else None
-            ),
-            forward_poll=(
-                forwarder.forward_poll if forwarder.enabled else None
-            ),
-        )
-        fresh = TaskListManager(
-            tl_id, self._store, matcher, time_source=self._time
-        )
-        with self._lock:
-            mgr = self._managers.get(key)
-            if mgr is None:
+            if mgr is not None:
+                return mgr
+            # per-key creation lock: construction leases from the store
+            # (blocking I/O) and starts threads — it must run outside
+            # the engine lock, but TWO racing constructors would both
+            # take store leases, fencing each other's rangeID and
+            # churning the lease on every creation race (ADVICE r4).
+            # Serializing per key means the loser never constructs.
+            creating = self._creating.setdefault(key, threading.Lock())
+        with creating:
+            with self._lock:
+                mgr = self._managers.get(key)
+            if mgr is not None:
+                return mgr
+            forwarder = Forwarder(tl_id, self)
+            matcher = TaskMatcher(
+                forward_offer=(
+                    forwarder.forward_offer if forwarder.enabled else None
+                ),
+                forward_poll=(
+                    forwarder.forward_poll if forwarder.enabled else None
+                ),
+            )
+            fresh = TaskListManager(
+                tl_id, self._store, matcher, time_source=self._time
+            )
+            with self._lock:
+                # NOTE: the _creating entry is deliberately never popped
+                # — a racer still parked on this lock object must
+                # re-check through the SAME lock after an unload/
+                # re-create cycle, or two constructors can race again.
+                # Cardinality is bounded by distinct task lists, same
+                # as _pollers.
                 self._managers[key] = fresh
-                return fresh
-        # raced another creator: theirs won, ours unwinds
-        fresh.stop()
-        return mgr
+            return fresh
 
     def _pick_partition(self, domain_id: str, name: str, write: bool) -> str:
         if TaskListID("", name, 0).is_partition:

@@ -135,7 +135,7 @@ class TaskWriter:
         )
         self._thread.start()
 
-    def append(self, info: TaskInfo) -> None:
+    def append(self, info: TaskInfo, timeout_s: float = 30.0) -> None:
         """Park until the batch containing ``info`` is persisted."""
         req = _AppendRequest(info)
         with self._lock:
@@ -143,9 +143,26 @@ class TaskWriter:
                 raise RuntimeError("task writer stopped")
             self._queue.append(req)
         self._signal.set()
-        req.done.wait(timeout=30.0)
+        req.done.wait(timeout=timeout_s)
         if not req.done.is_set():
-            raise TimeoutError("task append timed out")
+            # withdraw before raising: leaving the request queued means
+            # it may persist AFTER the caller retries, guaranteeing a
+            # duplicate backlog task on slow-store stalls (ADVICE r4).
+            with self._lock:
+                try:
+                    self._queue.remove(req)
+                    withdrawn = True
+                except ValueError:
+                    withdrawn = False  # already drained into a batch
+            if withdrawn:
+                raise TimeoutError("task append timed out")
+            # in-flight persist: it will resolve; give it a short grace
+            req.done.wait(timeout=5.0)
+            if not req.done.is_set():
+                raise TimeoutError(
+                    "task append timed out (write in flight; the task "
+                    "may still persist)"
+                )
         if req.error is not None:
             raise req.error
 

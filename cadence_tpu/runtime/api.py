@@ -103,6 +103,13 @@ class StartWorkflowRequest:
             raise BadRequestError(
                 "taskStartToCloseTimeoutSeconds must be positive"
             )
+        if self.retry_policy is not None:
+            from cadence_tpu.utils.backoff import validate_retry_policy
+
+            try:
+                validate_retry_policy(self.retry_policy)
+            except ValueError as e:
+                raise BadRequestError(str(e))
 
 
 @dataclasses.dataclass

@@ -70,7 +70,12 @@ def try_continue_after_close(
             attempt = ei.attempt + 1
 
     if initiator is None and ei.cron_schedule:
-        delay = next_cron_delay_seconds(ei.cron_schedule, now / 1e9)
+        # anchor '@every' at this run's execution time (start + first-
+        # decision backoff) the way mutableStateBuilder.GetCronBackoffDuration
+        # does (/root/reference/service/history/mutableStateBuilder.go:1048-1064)
+        anchor = (ei.first_decision_backoff_deadline
+                  or ei.start_timestamp) / 1e9
+        delay = next_cron_delay_seconds(ei.cron_schedule, now / 1e9, anchor)
         if delay > 0:
             initiator = ContinueAsNewInitiator.CronSchedule
             backoff = delay

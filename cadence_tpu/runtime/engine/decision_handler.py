@@ -137,6 +137,13 @@ class DecisionTaskHandler:
             from cadence_tpu.core.events import RetryPolicy
 
             retry_policy = RetryPolicy.from_dict(retry_policy)
+        if retry_policy is not None:
+            from cadence_tpu.utils.backoff import validate_retry_policy
+
+            try:
+                validate_retry_policy(retry_policy)
+            except ValueError as e:
+                raise DecisionFailure(_CAUSE_BAD_SCHEDULE_ACTIVITY, str(e))
         try:
             self.txn.add_activity_task_scheduled(
                 self.completed_id, self.now,

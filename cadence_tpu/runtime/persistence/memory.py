@@ -511,31 +511,44 @@ class MemoryHistoryManager(I.HistoryManager):
     def delete_history_branch(self, branch: BranchToken) -> None:
         with self._lock:
             tree = self._branches.get(branch.tree_id) or {}
-            # nodes of this branch that OTHER branches still reference
-            # as ancestor segments must survive — deleting a forked-from
-            # branch (e.g. base-run retention after a reset) must not
-            # destroy the descendants' shared prefix (reference
-            # historyV2 deleteBranch keeps shared ranges)
-            protected_end = 0
+            tree.pop(branch.branch_id, None)
+            if branch.tree_id in self._branches and not tree:
+                del self._branches[branch.tree_id]
+            # Sweep every node range in the tree no surviving branch
+            # owns or references as an ancestor segment (shared fork
+            # prefix — reference historyV2 deleteBranch keeps shared
+            # ranges). Whole-tree sweep also reclaims ranges a
+            # previously-deleted ancestor left behind, orphaned exactly
+            # when its last descendant goes (ADVICE r4).
+            live: dict = {}  # branch_id -> protected end (0 = whole)
             for bid, token in tree.items():
-                if bid == branch.branch_id:
-                    continue
+                live[bid] = 0
                 for anc in token.ancestors:
-                    if anc.branch_id == branch.branch_id:
-                        protected_end = max(
-                            protected_end, anc.end_node_id
+                    if live.get(anc.branch_id, 1) != 0:
+                        live[anc.branch_id] = max(
+                            live.get(anc.branch_id, 0), anc.end_node_id
                         )
-            key = (branch.tree_id, branch.branch_id)
-            if protected_end:
-                nodes = self._nodes.get(key, {})
-                for nid in [n for n in nodes if n >= protected_end]:
-                    del nodes[nid]
-            else:
-                self._nodes.pop(key, None)
-            if tree:
-                tree.pop(branch.branch_id, None)
-                if not tree:
-                    del self._branches[branch.tree_id]
+            # candidate ranges only (not a store-wide key scan): the
+            # deleted branch, its full ancestor chain, and every live
+            # branch id cover all ranges this delete can orphan —
+            # an orphan outside this set would have been swept when ITS
+            # last descendant was deleted (induction)
+            candidates = {branch.branch_id}
+            candidates.update(a.branch_id for a in branch.ancestors)
+            candidates.update(live)
+            for bid in candidates:
+                key = (branch.tree_id, bid)
+                if key not in self._nodes:
+                    continue
+                end = live.get(bid)
+                if end == 0:
+                    continue  # a live branch owns the whole range
+                if end is None:
+                    self._nodes.pop(key, None)
+                else:
+                    nodes = self._nodes[key]
+                    for nid in [n for n in nodes if n >= end]:
+                        del nodes[nid]
 
     def get_history_tree(self, tree_id: str) -> List[BranchToken]:
         with self._lock:

@@ -587,38 +587,50 @@ class SqliteHistoryManager(I.HistoryManager):
 
     def delete_history_branch(self, branch) -> None:
         with self.db.txn() as c:
-            # keep nodes other branches reference as ancestor segments
-            # (shared prefix of forks — see the memory twin)
-            protected_end = 0
-            rows = c.execute(
-                "SELECT branch_id, token FROM history_branches "
-                "WHERE tree_id=?",
-                (branch.tree_id,),
-            ).fetchall()
-            for bid, token in rows:
-                if bid == branch.branch_id:
-                    continue
-                for anc in BranchToken.from_json(token).ancestors:
-                    if anc.branch_id == branch.branch_id:
-                        protected_end = max(
-                            protected_end, anc.end_node_id
-                        )
-            if protected_end:
-                c.execute(
-                    "DELETE FROM history_nodes WHERE tree_id=? AND "
-                    "branch_id=? AND node_id>=?",
-                    (branch.tree_id, branch.branch_id, protected_end),
-                )
-            else:
-                c.execute(
-                    "DELETE FROM history_nodes WHERE tree_id=? AND "
-                    "branch_id=?",
-                    (branch.tree_id, branch.branch_id),
-                )
             c.execute(
                 "DELETE FROM history_branches WHERE tree_id=? AND branch_id=?",
                 (branch.tree_id, branch.branch_id),
             )
+            # Sweep every node range in the tree that no surviving
+            # branch owns or references as an ancestor segment (shared
+            # fork prefix — reference historyV2 deleteBranch keeps
+            # shared ranges). Sweeping the whole tree rather than just
+            # the target also reclaims ranges a *previously deleted*
+            # ancestor left behind, which become orphaned exactly when
+            # their last descendant goes (ADVICE r4).
+            live: dict = {}  # branch_id -> protected end (0 = whole)
+            for (token,) in c.execute(
+                "SELECT token FROM history_branches WHERE tree_id=?",
+                (branch.tree_id,),
+            ).fetchall():
+                bt = BranchToken.from_json(token)
+                live[bt.branch_id] = 0
+                for anc in bt.ancestors:
+                    if live.get(anc.branch_id, 1) != 0:
+                        live[anc.branch_id] = max(
+                            live.get(anc.branch_id, 0), anc.end_node_id
+                        )
+            node_bids = [r[0] for r in c.execute(
+                "SELECT DISTINCT branch_id FROM history_nodes "
+                "WHERE tree_id=?",
+                (branch.tree_id,),
+            ).fetchall()]
+            for bid in node_bids:
+                end = live.get(bid)
+                if end == 0:
+                    continue  # a live branch owns the whole range
+                if end is None:
+                    c.execute(
+                        "DELETE FROM history_nodes WHERE tree_id=? AND "
+                        "branch_id=?",
+                        (branch.tree_id, bid),
+                    )
+                else:
+                    c.execute(
+                        "DELETE FROM history_nodes WHERE tree_id=? AND "
+                        "branch_id=? AND node_id>=?",
+                        (branch.tree_id, bid, end),
+                    )
 
     def list_history_trees(self):
         """All (tree_id, branch tokens) pairs — the history scavenger's

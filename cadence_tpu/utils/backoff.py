@@ -35,28 +35,53 @@ class RetryPolicy:
     non_retriable_errors: Sequence[str] = ()
 
     def validate(self) -> None:
-        if self.initial_interval_seconds <= 0:
-            raise ValueError("InitialIntervalInSeconds must be positive")
-        if self.backoff_coefficient < 1:
-            raise ValueError("BackoffCoefficient cannot be less than 1")
-        if self.maximum_interval_seconds < 0:
-            raise ValueError("MaximumIntervalInSeconds cannot be negative")
-        if self.maximum_interval_seconds and (
-            self.maximum_interval_seconds < self.initial_interval_seconds
-        ):
-            raise ValueError(
-                "MaximumIntervalInSeconds cannot be less than "
-                "InitialIntervalInSeconds"
-            )
-        if self.maximum_attempts < 0:
-            raise ValueError("MaximumAttempts cannot be negative")
-        if self.expiration_seconds < 0:
-            raise ValueError("ExpirationIntervalInSeconds cannot be negative")
-        if self.maximum_attempts == 0 and self.expiration_seconds == 0:
-            raise ValueError(
-                "MaximumAttempts and ExpirationIntervalInSeconds cannot "
-                "both be zero"
-            )
+        validate_retry_policy(self)
+
+
+def validate_retry_policy(policy) -> None:
+    """Reject malformed user retry policies before they reach the FSM.
+
+    Mirrors ValidateRetryPolicy (/root/reference/common/util.go:357-384);
+    raises ValueError (callers map to BadRequest / decision failure).
+    A None policy is valid (no retry). Accepts either retry-policy
+    shape (core.events.RetryPolicy uses expiration_interval_seconds,
+    this module's uses expiration_seconds)."""
+    if policy is None:
+        return
+    # wire-decoded policies can carry explicit nulls; treat them as the
+    # reference's thrift Get* accessors do (nil -> zero value) so they
+    # fail validation as BadRequest, not as a server-side TypeError
+    def _n(v):
+        return 0 if v is None else v
+
+    initial = _n(policy.initial_interval_seconds)
+    coefficient = _n(policy.backoff_coefficient)
+    max_interval = _n(policy.maximum_interval_seconds)
+    max_attempts = _n(policy.maximum_attempts)
+    expiration = _n(getattr(policy, "expiration_interval_seconds",
+                            getattr(policy, "expiration_seconds", 0)))
+    if initial <= 0:
+        raise ValueError(
+            "InitialIntervalInSeconds must be greater than 0 on retry policy")
+    if coefficient < 1:
+        raise ValueError(
+            "BackoffCoefficient cannot be less than 1 on retry policy")
+    if max_interval < 0:
+        raise ValueError(
+            "MaximumIntervalInSeconds cannot be less than 0 on retry policy")
+    if max_interval > 0 and max_interval < initial:
+        raise ValueError("MaximumIntervalInSeconds cannot be less than "
+                         "InitialIntervalInSeconds on retry policy")
+    if max_attempts < 0:
+        raise ValueError(
+            "MaximumAttempts cannot be less than 0 on retry policy")
+    if expiration < 0:
+        raise ValueError(
+            "ExpirationIntervalInSeconds cannot be less than 0 on retry policy")
+    if max_attempts == 0 and expiration == 0:
+        raise ValueError(
+            "MaximumAttempts and ExpirationIntervalInSeconds are both 0; "
+            "at least one must be specified on retry policy")
 
 
 def next_backoff_interval_seconds(
@@ -82,6 +107,10 @@ def next_backoff_interval_seconds(
     # small intervals stay bit-exact (2.0**3 == 8, not exp-log 7.999…)
     import math
 
+    if policy.initial_interval_seconds <= 0:
+        # unvalidated policies default to 0 (core/events.RetryPolicy);
+        # math.log below would raise — preserve the stop semantics
+        return NO_INTERVAL
     if policy.backoff_coefficient <= 1.0:
         interval = float(policy.initial_interval_seconds)
     elif (

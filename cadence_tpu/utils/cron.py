@@ -103,15 +103,27 @@ class CronSchedule:
         # both restricted: either matches (standard cron OR rule)
         return dom_ok or dow_ok
 
-    def next_delay_seconds(self, now_s: float) -> int:
+    def next_delay_seconds(self, now_s: float, anchor_s: float = None) -> int:
         """Whole seconds from ``now_s`` (epoch) until the next fire; the
         reference's GetCronBackoffDuration equivalent. Always > 0.
+
+        ``anchor_s`` is the run's execution-start time: '@every N'
+        fires stay aligned to anchor + k*N (the reference steps
+        schedule.Next from start past close, backoff/cron.go:56-63)
+        instead of drifting later by each run's own duration. Field
+        specs are wall-clock anchored already, so anchor_s is moot there.
 
         Scans day-by-day (≤ ~1830 iterations over a 5-year horizon, the
         same horizon robfig/cron uses) so sparse specs like a leap-day
         '0 0 29 2 *' resolve without a minute-by-minute year walk.
         """
         if self.every_seconds:
+            if anchor_s is not None and anchor_s <= now_s:
+                k = int((now_s - anchor_s) // self.every_seconds) + 1
+                import math
+
+                return max(1, int(
+                    math.ceil(anchor_s + k * self.every_seconds - now_s)))
             return self.every_seconds
         minute, hour, _, _, _ = self.fields
         minutes = sorted(minute)
@@ -139,11 +151,13 @@ def validate_cron_schedule(spec: str) -> None:
         CronSchedule(spec)
 
 
-def next_cron_delay_seconds(spec: str, now_s: float) -> int:
+def next_cron_delay_seconds(
+    spec: str, now_s: float, anchor_s: float = None,
+) -> int:
     """Seconds until the next cron fire, or 0 when spec is empty/bad."""
     if not spec:
         return 0
     try:
-        return CronSchedule(spec).next_delay_seconds(now_s)
+        return CronSchedule(spec).next_delay_seconds(now_s, anchor_s)
     except ValueError:
         return 0

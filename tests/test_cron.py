@@ -69,3 +69,20 @@ def test_sparse_specs_resolve_fast():
     assert delay > 300 * 24 * 3600
     # and the scan is day-granular, not minute-granular
     assert _time.monotonic() - t0 < 0.5
+
+
+def test_every_anchored_at_execution_start():
+    # ADVICE r4: '@every N' must stay aligned to start + k*N (the
+    # reference steps schedule.Next from start past close), not drift
+    # later by each run's duration
+    start = WED_4AM
+    # run closed 472s after start: next aligned fire is start+600
+    assert CronSchedule("@every 10m").next_delay_seconds(
+        start + 472, anchor_s=start) == 128
+    # close exactly on a boundary -> next boundary, never 0
+    assert CronSchedule("@every 10m").next_delay_seconds(
+        start + 600, anchor_s=start) == 600
+    # no anchor (first run / unknown): flat interval as before
+    assert CronSchedule("@every 10m").next_delay_seconds(start + 472) == 600
+    # helper passthrough
+    assert next_cron_delay_seconds("@every 10m", start + 472, start) == 128

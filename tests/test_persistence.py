@@ -292,6 +292,43 @@ def test_history_fork(bundle):
     assert len(h.get_history_tree("tree1")) == 1
 
 
+def _tree_node_count(h, tree_id):
+    """Raw node count per tree (backend-peeking: orphan-leak assertions)."""
+    if hasattr(h, "_nodes"):  # memory backend
+        return sum(
+            len(v) for k, v in h._nodes.items() if k[0] == tree_id
+        )
+    with h.db.txn() as c:  # sqlite backend
+        return c.execute(
+            "SELECT COUNT(*) FROM history_nodes WHERE tree_id=?",
+            (tree_id,),
+        ).fetchone()[0]
+
+
+def test_delete_last_descendant_reclaims_ancestor_nodes(bundle):
+    # ADVICE r4: deleting a forked-from branch retains its shared prefix
+    # for descendants, but once the LAST descendant goes those retained
+    # nodes must be swept too — they were orphaned forever (no
+    # history_branches row, invisible to the scavenger).
+    h = bundle.history
+    main = h.new_history_branch("tree-orph")
+    h.append_history_nodes(main, _events(1, 3), transaction_id=1)
+    h.append_history_nodes(main, _events(4, 3), transaction_id=2)
+    h.append_history_nodes(main, _events(7, 3), transaction_id=3)
+    fork = h.fork_history_branch(main, fork_node_id=7)
+    h.append_history_nodes(fork, _events(7, 2, v=99), transaction_id=4)
+
+    h.delete_history_branch(main)
+    # shared prefix survives for the fork; main's own tail is gone
+    assert _tree_node_count(h, "tree-orph") > 0
+    batches, _ = h.read_history_branch(fork, 1, 10_000)
+    assert [b[0].event_id for b in batches] == [1, 4, 7]
+
+    h.delete_history_branch(fork)
+    assert h.get_history_tree("tree-orph") == []
+    assert _tree_node_count(h, "tree-orph") == 0
+
+
 # -- matching tasks ------------------------------------------------------
 
 

@@ -153,3 +153,50 @@ def test_writer_relases_after_lease_theft():
         task.finish(None)
     finally:
         mgr.stop()
+
+
+def test_append_timeout_withdraws_request():
+    """ADVICE r4: a timed-out append must not leave the request queued —
+    the task would persist later while the caller retries, guaranteeing
+    a duplicate backlog task."""
+    import pytest
+
+    bundle = create_memory_bundle()
+
+    class _StallingTaskManager(_CountingTaskManager):
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.stall = threading.Event()
+
+        def create_tasks(self, info, tasks):
+            self.stall.wait(5.0)
+            return super().create_tasks(info, tasks)
+
+    store = _StallingTaskManager(bundle.task)
+    mgr = _mgr(store)
+    try:
+        # first append: drained into an in-flight batch, store stalls
+        t1 = threading.Thread(
+            target=lambda: mgr._writer.append(
+                TaskInfo(domain_id="dom", workflow_id="w", run_id="r",
+                         task_id=0, schedule_id=1), timeout_s=0.2),
+            daemon=True)
+        t1.start()
+        import time as _t
+        _t.sleep(0.3)  # writer thread is now blocked inside create_tasks
+        # second append: stays queued behind the stalled batch, times
+        # out, and must WITHDRAW from the queue
+        with pytest.raises(TimeoutError):
+            mgr._writer.append(
+                TaskInfo(domain_id="dom", workflow_id="w", run_id="r",
+                         task_id=0, schedule_id=2), timeout_s=0.2)
+        store.stall.set()
+        t1.join(5.0)
+        _t.sleep(0.5)  # let the pump drain anything left
+        tasks = bundle.task.get_tasks(
+            "dom", "writer-tl", TASK_TYPE_DECISION, 0, 1 << 62, 100)
+        scheds = [t.schedule_id for t in tasks]
+        assert 2 not in scheds, scheds  # withdrawn, never persisted
+    finally:
+        store.stall.set()
+        mgr.stop()

@@ -47,6 +47,37 @@ def test_retry_policy_validation():
     RetryPolicy(maximum_attempts=3).validate()
 
 
+def test_zero_initial_interval_stops_not_crashes():
+    # regression (ADVICE r4 high): unvalidated policies default to
+    # initial_interval_seconds=0; the overflow guard's math.log raised
+    # 'math domain error' instead of returning NO_INTERVAL
+    p = RetryPolicy(initial_interval_seconds=0, backoff_coefficient=2.0,
+                    maximum_attempts=5)
+    assert next_backoff_interval_seconds(p, 1, 0, 0) == NO_INTERVAL
+    p2 = RetryPolicy(initial_interval_seconds=-3, backoff_coefficient=1.5,
+                     maximum_attempts=5)
+    assert next_backoff_interval_seconds(p2, 2, 0, 0) == NO_INTERVAL
+
+
+def test_start_request_rejects_malformed_retry_policy():
+    # validation mirrors common/util.go ValidateRetryPolicy, surfaced as
+    # BadRequest at StartWorkflow (reference wires it in frontend)
+    from cadence_tpu.core.events import RetryPolicy as EvRetryPolicy
+    from cadence_tpu.runtime.api import BadRequestError, StartWorkflowRequest
+
+    req = StartWorkflowRequest(
+        domain="d", workflow_id="w", workflow_type="t", task_list="tl",
+        execution_start_to_close_timeout_seconds=10,
+        task_start_to_close_timeout_seconds=5,
+        retry_policy=EvRetryPolicy(initial_interval_seconds=0,
+                                   maximum_attempts=3))
+    with pytest.raises(BadRequestError):
+        req.validate()
+    req.retry_policy = EvRetryPolicy(
+        initial_interval_seconds=1, maximum_attempts=3)
+    req.validate()
+
+
 def test_next_backoff_interval():
     p = RetryPolicy(
         initial_interval_seconds=1, backoff_coefficient=2.0,
