@@ -29,54 +29,61 @@ class MatchingClient:
         host = self.monitor.resolver("matching").lookup(task_list).identity
         return self._engines.get(host) or next(iter(self._engines.values()))
 
+    def _invoke(self, task_list: str, method: str, *args, **kwargs):
+        """Single routing hook every public method funnels through —
+        RoutedMatchingClient overrides it with a ring-re-resolving
+        retry loop (reference client/matching/retryableClient.go)."""
+        return getattr(self._engine_for(task_list), method)(*args, **kwargs)
+
     def add_decision_task(self, domain_id, workflow_id, run_id, task_list,
                           schedule_id, schedule_to_start_timeout_seconds=0):
-        return self._engine_for(task_list).add_decision_task(
-            domain_id, workflow_id, run_id, task_list, schedule_id,
-            schedule_to_start_timeout_seconds,
+        return self._invoke(
+            task_list, "add_decision_task", domain_id, workflow_id, run_id,
+            task_list, schedule_id, schedule_to_start_timeout_seconds,
         )
 
     def add_activity_task(self, domain_id, workflow_id, run_id, task_list,
                           schedule_id, schedule_to_start_timeout_seconds=0):
-        return self._engine_for(task_list).add_activity_task(
-            domain_id, workflow_id, run_id, task_list, schedule_id,
-            schedule_to_start_timeout_seconds,
+        return self._invoke(
+            task_list, "add_activity_task", domain_id, workflow_id, run_id,
+            task_list, schedule_id, schedule_to_start_timeout_seconds,
         )
 
     def poll_for_decision_task(self, request):
-        return self._engine_for(request.task_list).poll_for_decision_task(
-            request
+        return self._invoke(
+            request.task_list, "poll_for_decision_task", request
         )
 
     def poll_for_activity_task(self, request):
-        return self._engine_for(request.task_list).poll_for_activity_task(
-            request
+        return self._invoke(
+            request.task_list, "poll_for_activity_task", request
         )
 
     def describe_task_list(self, domain_id, name, task_type):
-        return self._engine_for(name).describe_task_list(
-            domain_id, name, task_type
+        return self._invoke(
+            name, "describe_task_list", domain_id, name, task_type
         )
 
     def list_task_list_partitions(self, domain_id, name):
-        return self._engine_for(name).list_task_list_partitions(
-            domain_id, name
+        return self._invoke(
+            name, "list_task_list_partitions", domain_id, name
         )
 
     def cancel_outstanding_polls(self, domain_id, name, task_type):
-        return self._engine_for(name).cancel_outstanding_polls(
-            domain_id, name, task_type
+        return self._invoke(
+            name, "cancel_outstanding_polls", domain_id, name, task_type
         )
 
     def query_workflow(self, domain_id, task_list, workflow_id, run_id,
                        query_type, query_args=b"", timeout_s=10.0):
-        return self._engine_for(task_list).query_workflow(
-            domain_id, task_list, workflow_id, run_id, query_type,
-            query_args, timeout_s,
+        return self._invoke(
+            task_list, "query_workflow", domain_id, task_list, workflow_id,
+            run_id, query_type, query_args, timeout_s,
         )
 
     def respond_query_task_completed(self, task_list, query_id,
                                      result=b"", error=""):
-        return self._engine_for(task_list).respond_query_task_completed(
-            query_id, result, error
+        return self._invoke(
+            task_list, "respond_query_task_completed", query_id, result,
+            error
         )

@@ -18,11 +18,57 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+import grpc
+
+from cadence_tpu.runtime.controller import ShardOwnershipLostError
 from cadence_tpu.runtime.membership import Monitor
+from cadence_tpu.utils.backoff import ExponentialRetryPolicy, retry
 from cadence_tpu.utils.hashing import shard_for_workflow
 
 from .history import HistoryClient
 from .matching import MatchingClient
+
+# Service-client retry schedule: the reference wraps every service
+# client in a retryable layer (client/history/retryableClient.go:1-60,
+# client/matching/retryableClient.go) with
+# CreateHistoryServiceRetryPolicy (50ms initial, bounded expiration).
+# Each attempt re-resolves the ring, so a shard that moved mid-call is
+# found at its new owner once the ring settles.
+ROUTED_RETRY_POLICY = ExponentialRetryPolicy(
+    initial_interval_s=0.05,
+    backoff_coefficient=2.0,
+    maximum_interval_s=2.0,
+    expiration_interval_s=10.0,
+    maximum_attempts=0,
+)
+
+
+def is_routed_retryable(e: Exception) -> bool:
+    """ShardOwnershipLost + transport-level transients (the reference's
+    common.IsServiceTransientError + membership re-resolution cases)."""
+    from cadence_tpu.runtime.persistence.errors import (
+        ShardOwnershipLostError as PersistenceShardOwnershipLost,
+    )
+
+    # both ownership-lost shapes: the controller's (remote handler, and
+    # the rpc client rebuilds this class from the wire) AND the
+    # persistence layer's rangeID-fencing sibling, which the LOCAL
+    # engine path surfaces directly when the shard moved away mid-call
+    if isinstance(e, (ShardOwnershipLostError,
+                      PersistenceShardOwnershipLost, ConnectionError)):
+        return True
+    if isinstance(e, grpc.RpcError):
+        # CANCELLED: the stub cache closed this channel under us (its
+        # host left the ring mid-call) — the next attempt re-resolves
+        # and dials fresh
+        return e.code() in (grpc.StatusCode.UNAVAILABLE,
+                            grpc.StatusCode.CANCELLED)
+    if isinstance(e, ValueError) and "closed channel" in str(e):
+        return True  # raced an evicted stub; re-resolve and redial
+    # ring momentarily empty while a host is being replaced
+    if isinstance(e, RuntimeError) and "no hosts in service ring" in str(e):
+        return True
+    return False
 
 
 class _StubCache:
@@ -37,6 +83,19 @@ class _StubCache:
             if stub is None:
                 stub = self._stubs[address] = self._factory(address)
             return stub
+
+    def evict(self, addresses) -> None:
+        """Drop (and close) stubs for hosts that left the ring — an
+        address reused by a new instance must get a fresh channel."""
+        with self._lock:
+            stubs = [
+                self._stubs.pop(a) for a in addresses if a in self._stubs
+            ]
+        for stub in stubs:
+            try:
+                stub.close()
+            except Exception:
+                pass
 
     def close(self) -> None:
         with self._lock:
@@ -68,8 +127,14 @@ class RoutedHistoryClient(HistoryClient):
             else (local_controller.num_shards if local_controller else 1)
         )
         self._stubs = _StubCache(RemoteHistory)
+        self.retry_policy = ROUTED_RETRY_POLICY
+        self._listener = f"routed-history-{id(self)}"
+        monitor.resolver("history").add_listener(
+            self._listener,
+            lambda ev: self._stubs.evict(ev.hosts_removed),
+        )
 
-    def _call(self, workflow_id: str, method: str, *args, **kwargs):
+    def _call_once(self, workflow_id: str, method: str, *args, **kwargs):
         shard_id = shard_for_workflow(workflow_id, self.num_shards)
         owner = self.monitor.resolver("history").lookup(
             str(shard_id)
@@ -80,7 +145,15 @@ class RoutedHistoryClient(HistoryClient):
             )(*args, **kwargs)
         return getattr(self._stubs.get(owner), method)(*args, **kwargs)
 
+    def _call(self, workflow_id: str, method: str, *args, **kwargs):
+        return retry(
+            lambda: self._call_once(workflow_id, method, *args, **kwargs),
+            policy=self.retry_policy,
+            is_retriable=is_routed_retryable,
+        )
+
     def close(self) -> None:
+        self.monitor.resolver("history").remove_listener(self._listener)
         self._stubs.close()
 
 
@@ -100,6 +173,12 @@ class RoutedMatchingClient(MatchingClient):
         self.local_engine = local_engine
         self.local_identity = local_identity or monitor.self_identity
         self._stubs = _StubCache(RemoteMatching)
+        self.retry_policy = ROUTED_RETRY_POLICY
+        self._listener = f"routed-matching-{id(self)}"
+        monitor.resolver("matching").add_listener(
+            self._listener,
+            lambda ev: self._stubs.evict(ev.hosts_removed),
+        )
 
     def _engine_for(self, task_list: str):
         owner = self.monitor.resolver("matching").lookup(task_list).identity
@@ -107,5 +186,16 @@ class RoutedMatchingClient(MatchingClient):
             return self.local_engine
         return self._stubs.get(owner)
 
+    def _invoke(self, task_list: str, method: str, *args, **kwargs):
+        # each attempt re-resolves the ring (retryableClient.go parity)
+        return retry(
+            lambda: getattr(self._engine_for(task_list), method)(
+                *args, **kwargs
+            ),
+            policy=self.retry_policy,
+            is_retriable=is_routed_retryable,
+        )
+
     def close(self) -> None:
+        self.monitor.resolver("matching").remove_listener(self._listener)
         self._stubs.close()

@@ -145,6 +145,7 @@ def plane(tmp_path):
     p.domain_id = domain_id
     p.my_h, p.my_m, p.child_h, p.child_m = my_h, my_m, child_h, child_m
     p.hc, p.mc = hc, mc
+    p.child = child
     try:
         yield p
     finally:
@@ -239,3 +240,117 @@ def test_remote_matching_poll(plane):
         if task is not None:
             break
     assert task is not None, "remote matching poll returned nothing"
+
+
+def test_shard_move_mid_traffic_converges(plane):
+    """Kill the owning host mid-traffic (VERDICT r4 #4): the routed
+    client must retry through ShardOwnershipLost/UNAVAILABLE, re-resolve
+    the ring once the dead host is evicted, and converge on the new
+    owner with NO error surfaced to the caller."""
+    import threading
+
+    from cadence_tpu.runtime.api import SignalRequest
+
+    r = plane.monitor.resolver("history")
+    wf = next(
+        f"wf-m-{i}" for i in range(5000)
+        if r.lookup(
+            str(shard_for_workflow(f"wf-m-{i}", NUM_SHARDS))
+        ).identity == plane.child_h
+    )
+    tl = _pick(plane.monitor, "matching", plane.my_m,
+               lambda i: f"tl-m-{i}")
+    run_id = plane.frontend.start_workflow_execution(
+        StartWorkflowRequest(
+            domain="tp-domain", workflow_id=wf, workflow_type="echo",
+            task_list=tl, execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    assert run_id
+
+    # the owner dies hard; nothing has updated the ring yet
+    plane.child.kill()
+    plane.child.wait(timeout=5)
+
+    errors = []
+
+    def _signal():
+        try:
+            plane.frontend.signal_workflow_execution(
+                SignalRequest(domain="tp-domain", workflow_id=wf,
+                              signal_name="mid-move", input=b"x")
+            )
+        except Exception as e:  # surfaced error = test failure
+            errors.append(e)
+
+    t = threading.Thread(target=_signal, daemon=True)
+    t.start()
+    # while the signal is retrying against the dead host, the ring is
+    # updated (stand-in for the failure detector evicting the host);
+    # the parent's controller rebalances and acquires the shard
+    time.sleep(0.7)
+    plane.monitor.resolver("history").set_hosts([plane.my_h])
+    plane.monitor.resolver("matching").set_hosts([plane.my_m])
+    t.join(timeout=15)
+    assert not t.is_alive(), "signal never converged"
+    assert not errors, f"caller saw {errors!r}"
+
+    events, _ = plane.frontend.get_workflow_execution_history(
+        "tp-domain", wf, run_id
+    )
+    names = [e.event_type.name for e in events]
+    assert "WorkflowExecutionSignaled" in names, names
+
+
+def test_dead_host_evicted_and_shards_reacquired_without_remove_host(plane):
+    """VERDICT r4 #5: kill -9 the owning process and make NO manual ring
+    update. The failure detector must notice within its probe budget,
+    evict the host (firing rebalance), and a routed call issued against
+    the dead owner must converge on the survivor with no error."""
+    from cadence_tpu.rpc.client import grpc_ping
+    from cadence_tpu.runtime.api import SignalRequest
+    from cadence_tpu.runtime.membership import FailureDetector
+
+    r = plane.monitor.resolver("history")
+    wf = next(
+        f"wf-fd-{i}" for i in range(5000)
+        if r.lookup(
+            str(shard_for_workflow(f"wf-fd-{i}", NUM_SHARDS))
+        ).identity == plane.child_h
+    )
+    tl = _pick(plane.monitor, "matching", plane.my_m,
+               lambda i: f"tl-fd-{i}")
+    run_id = plane.frontend.start_workflow_execution(
+        StartWorkflowRequest(
+            domain="tp-domain", workflow_id=wf, workflow_type="echo",
+            task_list=tl, execution_start_to_close_timeout_seconds=60,
+        )
+    )
+    assert run_id
+
+    det = FailureDetector(
+        plane.monitor, grpc_ping,
+        own_identities={plane.my_h, plane.my_m},
+        services=["history", "matching"],
+        probe_interval_s=0.2, failure_threshold=2,
+    ).start()
+    try:
+        plane.child.kill()
+        plane.child.wait(timeout=5)
+        # no set_hosts/remove_host anywhere: the detector does it
+        plane.frontend.signal_workflow_execution(
+            SignalRequest(domain="tp-domain", workflow_id=wf,
+                          signal_name="after-death", input=b"x")
+        )
+        members = [
+            h.identity
+            for h in plane.monitor.resolver("history").members()
+        ]
+        assert plane.child_h not in members, members
+        events, _ = plane.frontend.get_workflow_execution_history(
+            "tp-domain", wf, run_id
+        )
+        names = [e.event_type.name for e in events]
+        assert "WorkflowExecutionSignaled" in names, names
+    finally:
+        det.stop()
