@@ -33,12 +33,15 @@ class RunningServer:
     matching_client: object = None
     rpc_servers: Dict[str, object] = dataclasses.field(default_factory=dict)
     pprof: object = None
+    failure_detector: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
         return {name: s.address for name, s in self.rpc_servers.items()}
 
     def stop(self) -> None:
+        if self.failure_detector is not None:
+            self.failure_detector.stop()
         if self.pprof is not None:
             self.pprof.stop()
         for s in self.rpc_servers.values():
@@ -117,9 +120,24 @@ def start_services(
     for service in services:
         monitor.join(service, addr(service))
 
+    # failure detection (SWIM stand-in): probe ring peers, evict the
+    # dead, let the shard controller rebalance (ref rpMonitor.go:44)
+    failure_detector = None
+    if cfg.ring.probe_interval_seconds > 0:
+        from cadence_tpu.rpc.client import grpc_ping
+        from cadence_tpu.runtime.membership import FailureDetector
+
+        failure_detector = FailureDetector(
+            monitor, grpc_ping,
+            own_identities={addr(s) for s in services},
+            probe_interval_s=cfg.ring.probe_interval_seconds,
+            failure_threshold=cfg.ring.failure_threshold,
+        ).start()
+
     out = RunningServer(
         config=cfg, services=services, persistence=persistence,
         domains=domains, monitor=monitor,
+        failure_detector=failure_detector,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
     # first configured service's port wins, bound on that service's

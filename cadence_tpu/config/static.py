@@ -57,11 +57,15 @@ class ServiceConfig:
 @dataclasses.dataclass
 class RingConfig:
     """ref config.go Ringpop (bootstrapHosts): static host lists per
-    service ring; identities are dial addresses."""
+    service ring; identities are dial addresses. The failure detector
+    (membership.FailureDetector, SWIM stand-in) probes ring peers and
+    evicts dead hosts; interval 0 disables it."""
 
     bootstrap_hosts: Dict[str, List[str]] = dataclasses.field(
         default_factory=dict
     )
+    probe_interval_seconds: float = 1.0
+    failure_threshold: int = 3
 
 
 @dataclasses.dataclass
@@ -176,6 +180,8 @@ def load_config_dict(raw: dict) -> ServerConfig:
     if ring:
         cfg.ring = RingConfig(**_take(ring, {
             "bootstrapHosts": "bootstrap_hosts",
+            "probeIntervalSeconds": "probe_interval_seconds",
+            "failureThreshold": "failure_threshold",
         }, "ring"))
 
     cm = raw.pop("clusterMetadata", None)

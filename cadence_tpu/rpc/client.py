@@ -123,3 +123,58 @@ class RemoteClusterRPCClient:
 
     def close(self) -> None:
         self._stub.close()
+
+
+# -- liveness probe (failure detector transport) -------------------------
+
+_PING_SERVICES = {
+    "frontend": "cadence_tpu.Frontend",
+    "history": "cadence_tpu.History",
+    "matching": "cadence_tpu.Matching",
+}
+
+
+def grpc_ping(service: str, address: str, timeout_s: float = 1.0) -> bool:
+    """One direct liveness probe: the built-in ``ping`` method every
+    ServiceRPCServer exposes (rpc/server.py). Transport for
+    membership.FailureDetector — the stand-in for ringpop's SWIM
+    direct-probe (/root/reference/common/membership/rpMonitor.go:44).
+
+    A fresh channel per probe keeps the probe honest: a cached channel
+    can report a stale READY state for a port whose process just died.
+    """
+    service_name = _PING_SERVICES.get(service)
+    if service_name is None:
+        return True  # no RPC surface to probe (e.g. worker ring)
+    channel = grpc.insecure_channel(address)
+    try:
+        call = channel.unary_unary(
+            f"/{service_name}/ping",
+            request_serializer=codec.dumps,
+            response_deserializer=codec.loads_envelope,
+        )
+        call(([], {}), timeout=timeout_s)
+        return True
+    except grpc.RpcError as e:
+        if e.code() == grpc.StatusCode.UNAVAILABLE:
+            return False  # connection refused/reset: the process is gone
+        # DEADLINE_EXCEEDED etc. can just mean the service thread pool
+        # is saturated (64 long-polls queue the ping behind them) — a
+        # busy host must not be evicted as dead. Distinguish with a raw
+        # TCP connect: a live process still accepts; a crashed or
+        # blackholed one does not.
+        return _tcp_alive(address, timeout_s)
+    finally:
+        channel.close()
+
+
+def _tcp_alive(address: str, timeout_s: float) -> bool:
+    import socket
+
+    host, _, port = address.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=timeout_s):
+            return True
+    except OSError:
+        return False

@@ -58,6 +58,15 @@ class _Generic(grpc.GenericRpcHandler):
         if not call_details.method.startswith(prefix):
             return None
         name = call_details.method[len(prefix):]
+        if name == "ping":
+            # built-in liveness probe for the failure detector
+            # (membership.FailureDetector; ref ringpop's direct probe,
+            # common/membership/rpMonitor.go) — no handler dispatch
+            return grpc.unary_unary_rpc_method_handler(
+                lambda request, context: {"ok": True},
+                request_deserializer=codec.loads,
+                response_serializer=codec.dumps_enveloped,
+            )
         fn = self._resolve(name)
         if fn is None:
             return None

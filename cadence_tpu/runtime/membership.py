@@ -159,3 +159,100 @@ def single_host_monitor(identity: str = "onebox") -> Monitor:
     for s in Monitor.SERVICES:
         m.join(s)
     return m
+
+
+class FailureDetector:
+    """Direct-probe liveness monitor: the SWIM stand-in.
+
+    Reference: ringpop gossip drives membership so a dead host's shards
+    are reacquired automatically (/root/reference/common/membership/
+    rpMonitor.go:44). Here each host probes its rings' peers directly
+    (``probe(service, address) -> bool``, transport injected — the rpc
+    plane provides grpc_ping); ``failure_threshold`` consecutive misses
+    evict the peer from THIS host's rings via Monitor.leave, firing
+    resolver listeners so the shard controller rebalances and reacquires
+    the dead host's shards under rangeID fencing. Hosts detect
+    independently, so rings may diverge for ~a probe interval — the
+    same transient SWIM suspicion allows. Recovery (a restarted host
+    rejoining) is driven by that host's own bootstrap join, as before.
+    """
+
+    def __init__(
+        self,
+        monitor: Monitor,
+        probe: Callable[[str, str], bool],
+        own_identities: Optional[set] = None,
+        services: Optional[List[str]] = None,
+        probe_interval_s: float = 1.0,
+        failure_threshold: int = 3,
+    ) -> None:
+        self.monitor = monitor
+        self.probe = probe
+        self.own = set(own_identities or {monitor.self_identity})
+        self.services = list(services or Monitor.SERVICES)
+        self.probe_interval_s = probe_interval_s
+        self.failure_threshold = failure_threshold
+        self._misses: Dict[tuple, int] = {}
+        # evicted peers stay on the probe list: a restarted host that
+        # answers again is re-admitted (monitor.join) — without this,
+        # eviction would be permanent on every SURVIVING host and a
+        # returning peer would split the rings (it sees {A,B}, the
+        # survivor sees {A}), double-acquiring shards forever
+        self._evicted: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FailureDetector":
+        self._thread = threading.Thread(
+            target=self._run, name="failureDetector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # detector must outlive transient faults
+                pass
+
+    def probe_once(self) -> None:
+        """One probe round over every ring peer + every evicted peer
+        (test-callable). Probes run concurrently so one blackholed host
+        cannot stretch the round by its full timeout per peer; ring
+        mutations happen after the round, on this thread."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        targets = []  # (service, identity, currently_evicted)
+        for service in self.services:
+            for host in self.monitor.resolver(service).members():
+                if host.identity not in self.own:
+                    targets.append((service, host.identity, False))
+        targets.extend((s, i, True) for (s, i) in self._evicted)
+        if not targets:
+            return
+        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+            alive = list(pool.map(
+                lambda t: self.probe(t[0], t[1]), targets
+            ))
+        for (service, ident, evicted), ok in zip(targets, alive):
+            key = (service, ident)
+            if ok:
+                self._misses.pop(key, None)
+                if evicted:
+                    self._evicted.discard(key)
+                    self.monitor.join(service, ident)
+                continue
+            if evicted:
+                continue
+            n = self._misses.get(key, 0) + 1
+            self._misses[key] = n
+            if n >= self.failure_threshold:
+                self._misses.pop(key, None)
+                self._evicted.add(key)
+                self.monitor.leave(service, ident)

@@ -285,3 +285,39 @@ def test_ring_distributes_shards_across_similar_identities():
         assert 0 < owned_b < 16, (
             f"degenerate ring for {a} / {b}: host B owns {owned_b}/16"
         )
+
+
+def test_failure_detector_evicts_then_readmits():
+    """Unit-level detector semantics with a scripted probe: K misses
+    evict; an evicted peer KEEPS being probed and is re-admitted the
+    moment it answers again (a restarted host must not split the rings
+    — its own monitor sees {A,B} while the survivor sees only {A})."""
+    from cadence_tpu.runtime.membership import FailureDetector, Monitor
+
+    monitor = Monitor(self_identity="hostA")
+    monitor.resolver("history").set_hosts(["hostA", "hostB"])
+    alive = {"hostB": True}
+    det = FailureDetector(
+        monitor, lambda service, ident: alive.get(ident, True),
+        own_identities={"hostA"}, services=["history"],
+        failure_threshold=2,
+    )
+
+    ring = lambda: sorted(
+        h.identity for h in monitor.resolver("history").members()
+    )
+    det.probe_once()
+    assert ring() == ["hostA", "hostB"]
+
+    alive["hostB"] = False
+    det.probe_once()
+    assert ring() == ["hostA", "hostB"]  # 1 miss < threshold
+    det.probe_once()
+    assert ring() == ["hostA"]           # evicted at threshold
+
+    det.probe_once()
+    assert ring() == ["hostA"]           # still dead, still out
+
+    alive["hostB"] = True
+    det.probe_once()
+    assert ring() == ["hostA", "hostB"]  # re-admitted on first answer
