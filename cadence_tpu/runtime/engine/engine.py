@@ -1154,6 +1154,7 @@ class HistoryEngine:
                 remote_clusters=(
                     cm.enabled_remote_clusters() if cm is not None else None
                 ),
+                metrics=getattr(self, "metrics", None),
             )
         return self._replicator_queue
 

@@ -139,6 +139,16 @@ class QueueAckManager:
                 if s in (_RUNNING, _DONE)
             )
 
+    def held(self) -> int:
+        """Parked (DEFERRED/RETRY) entries — the standby hold depth: a
+        passive-domain span awaiting replication/failover wedges the ack
+        sweep exactly this deep (the task_held gauge's source)."""
+        with self._lock:
+            return sum(
+                1 for s in self._outstanding.values()
+                if s not in (_RUNNING, _DONE)
+            )
+
     def defer(self, key, delay_s: float) -> None:
         """Hold a read-but-unprocessable task (passive domain / standby
         verification pending). The entry stays outstanding — blocking

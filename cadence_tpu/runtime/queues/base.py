@@ -166,6 +166,11 @@ class QueueProcessorBase:
             except Exception:
                 self._log.exception(f"queue {self.name} batch failed")
             self.ack.update_ack_level()
+            # in-flight depth + parked depth (standby "hold depth": a
+            # DeferTask-parked span wedging the ack sweep; reference
+            # defs.go task-type queue gauges)
+            self._metrics.gauge("task_outstanding", self.ack.outstanding())
+            self._metrics.gauge("task_held", self.ack.held())
 
     def _process_batch(self) -> None:
         while not self._stopped.is_set():

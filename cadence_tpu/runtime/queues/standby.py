@@ -44,7 +44,12 @@ from cadence_tpu.utils.log import get_logger
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, defer_task
-from .base import QueueProcessorBase, ResumeCursor, read_due_timers
+from .base import (
+    QueueProcessorBase,
+    ResumeCursor,
+    read_due_timers,
+    timed_task,
+)
 from .timer_gate import RemoteTimerGate
 
 
@@ -194,6 +199,7 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
         batch_size: int = 64,
         local_cluster: str = "",
         on_handover=None,
+        metrics=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -235,6 +241,7 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
             task_key=lambda t: t.task_id,
             worker_count=worker_count,
             batch_size=batch_size,
+            metrics=metrics,
         )
 
     # -- verification dispatch ----------------------------------------
@@ -377,7 +384,10 @@ class TimerQueueStandbyProcessor:
         batch_size: int = 64,
         local_cluster: str = "",
         on_handover=None,
+        metrics=None,
     ) -> None:
+        from cadence_tpu.utils.metrics import NOOP
+
         self.shard = shard
         self.engine = engine
         self.cluster = cluster
@@ -385,6 +395,10 @@ class TimerQueueStandbyProcessor:
         self._log = get_logger(
             "cadence_tpu.queue.timer-standby",
             shard=shard.shard_id, cluster=cluster,
+        )
+        self._metrics = (metrics or NOOP).tagged(
+            service="history_queue",
+            queue=f"timer-standby-{cluster}-{shard.shard_id}",
         )
         shard.ensure_cluster_ack_levels(cluster)
         self.ack = QueueAckManager(
@@ -460,6 +474,8 @@ class TimerQueueStandbyProcessor:
             except Exception:
                 self._log.exception("standby timer pump failed")
             self.ack.update_ack_level()
+            self._metrics.gauge("task_outstanding", self.ack.outstanding())
+            self._metrics.gauge("task_held", self.ack.held())
 
     def _process_due(self) -> None:
         remote_now = self.gate.current_time()
@@ -492,6 +508,10 @@ class TimerQueueStandbyProcessor:
             self.gate.update(future[0].visibility_timestamp)
 
     def _run_task(self, task: TimerTask, key) -> None:
+        with timed_task(self._metrics, task) as scope:
+            self._run_task_inner(task, key, scope)
+
+    def _run_task_inner(self, task: TimerTask, key, scope) -> None:
         for attempt in range(self._TASK_RETRY_COUNT):
             if self._stopped.is_set():
                 return
@@ -504,6 +524,7 @@ class TimerQueueStandbyProcessor:
             except EntityNotExistsServiceError:
                 break
             except Exception:
+                scope.inc("task_errors")
                 if attempt == self._TASK_RETRY_COUNT - 1:
                     self._log.exception(
                         f"standby timer task {key} dropped after "

@@ -117,6 +117,8 @@ class TimerQueueProcessor:
             except Exception:
                 self._log.exception("timer pump failed")
             self.ack.update_ack_level()
+            self._metrics.gauge("task_outstanding", self.ack.outstanding())
+            self._metrics.gauge("task_held", self.ack.held())
 
     def _process_due(self) -> None:
         now = self.shard.now()

@@ -72,6 +72,7 @@ class TransferQueueProcessor(QueueProcessorBase):
         worker_count: int = 4,
         batch_size: int = 64,
         standby_clusters=(),
+        metrics=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -116,6 +117,7 @@ class TransferQueueProcessor(QueueProcessorBase):
             task_key=lambda t: t.task_id,
             worker_count=worker_count,
             batch_size=batch_size,
+            metrics=metrics,
         )
 
     # -- dispatch ------------------------------------------------------

@@ -87,12 +87,19 @@ class ReplicationTaskProcessor:
         fetcher: ReplicationTaskFetcher,
         rereplicator: Optional[HistoryRereplicator] = None,
         max_retry: int = 3,
+        metrics=None,
     ) -> None:
+        from cadence_tpu.utils.metrics import NOOP
+
         self.shard = shard
         self.replicator = replicator
         self.fetcher = fetcher
         self.rereplicator = rereplicator
         self.max_retry = max_retry
+        self._metrics = (metrics or NOOP).tagged(
+            service="history_replication", shard=str(shard.shard_id),
+            cluster=fetcher.cluster,
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # per-workflow-sequential, cross-workflow-parallel fallback
@@ -126,6 +133,18 @@ class ReplicationTaskProcessor:
         duplicate is detected and skipped by version-history bookkeeping
         (at-least-once, matching the reference's lastProcessedMessageId
         ack)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        applied = self._process_cycle()
+        if applied:
+            self._metrics.inc("replication_tasks_applied", applied)
+            self._metrics.record(
+                "replication_apply_latency", _time.perf_counter() - t0
+            )
+        return applied
+
+    def _process_cycle(self) -> int:
         msgs = self.fetcher.fetch(self.shard.shard_id)
         if msgs.source_time_ns:
             # the stream carries the source cluster's clock; standby

@@ -30,7 +30,10 @@ class ReplicatorQueueProcessor:
         shard: ShardContext,
         batch_size: int = 100,
         remote_clusters: Optional[List[str]] = None,
+        metrics=None,
     ) -> None:
+        from cadence_tpu.utils.metrics import NOOP
+
         self.shard = shard
         self.batch_size = batch_size
         self._lock = threading.Lock()
@@ -40,6 +43,10 @@ class ReplicatorQueueProcessor:
         self._cluster_ack: Dict[str, int] = {
             c: 0 for c in (remote_clusters or [])
         }
+        self._metrics = (metrics or NOOP).tagged(
+            service="history_replication", shard=str(shard.shard_id)
+        )
+        self._max_served = 0
 
     # -- hydration ----------------------------------------------------
 
@@ -161,6 +168,14 @@ class ReplicatorQueueProcessor:
             if msg is not None:
                 out.append(msg)
             last_id = max(last_id, t.task_id)
+        with self._lock:
+            self._max_served = max(self._max_served, last_id)
+            # how far this consumer trails the newest task this queue
+            # has served (reference defs.go replication lag gauges)
+            lag = self._max_served - self._cluster_ack.get(cluster, 0)
+        self._metrics.tagged(cluster=cluster).gauge(
+            "replication_ack_lag", max(0, lag)
+        )
         return ReplicationMessages(
             tasks=out, last_retrieved_id=last_id, has_more=has_more,
             source_time_ns=self.shard.now(),

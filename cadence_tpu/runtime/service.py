@@ -40,13 +40,21 @@ class HistoryService:
         time_source: Optional[TimeSource] = None,
         queue_worker_count: int = 4,
         cluster_metadata=None,
+        metrics=None,
     ) -> None:
+        from cadence_tpu.utils.metrics import Scope
+
         self.cluster_metadata = cluster_metadata
         self.persistence = persistence
         self.domains = domain_cache
         self.monitor = monitor
         self._time = time_source
         self._queue_workers = queue_worker_count
+        # per-task-type queue triples + standby hold depth + replication
+        # lag all hang off this scope (reference common/metrics/defs.go
+        # task-type scopes); a real registry by default so canary/tests
+        # can assert on it via service.metrics.registry
+        self.metrics = metrics if metrics is not None else Scope()
         self._log = get_logger(
             "cadence_tpu.history.service", host=monitor.self_identity
         )
@@ -90,17 +98,20 @@ class HistoryService:
     def _build_shard(self, shard: ShardContext) -> _ShardHandle:
         engine = HistoryEngine(shard, self.domains)
         engine.cluster_metadata = self.cluster_metadata
+        engine.metrics = self.metrics
         engine.matching_client = self.matching_client
         has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(
             shard, engine, self.matching_client, self.history_client,
             worker_count=self._queue_workers,
             standby_clusters=self.standby_clusters,
+            metrics=self.metrics,
         )
         timer = TimerQueueProcessor(
             shard, engine, matching=self.matching_client,
             worker_count=self._queue_workers,
             standby_clusters=self.standby_clusters,
+            metrics=self.metrics,
         )
         processors = [transfer, timer]
         notifiers = [transfer.notify]
@@ -121,11 +132,11 @@ class HistoryService:
         for cluster in self.standby_clusters:
             ts = TransferQueueStandbyProcessor(
                 shard, engine, cluster, local_cluster=local_cluster,
-                on_handover=transfer_handover,
+                on_handover=transfer_handover, metrics=self.metrics,
             )
             tm = TimerQueueStandbyProcessor(
                 shard, engine, cluster, local_cluster=local_cluster,
-                on_handover=timer_handover,
+                on_handover=timer_handover, metrics=self.metrics,
             )
             processors += [ts, tm]
             notifiers.append(ts.notify)
@@ -150,7 +161,7 @@ class HistoryService:
             processors.append(
                 ReplicationTaskProcessor(
                     shard, engine.ndc_replicator, fetcher,
-                    rereplicator=rerepl,
+                    rereplicator=rerepl, metrics=self.metrics,
                 )
             )
         for p in processors:

@@ -67,8 +67,20 @@ MATCHING_OPS = (
     "list_task_list_partitions",
 )
 
-# queue task-execution metrics are tagged (queue=..., task_type=...)
-QUEUE_METRICS = ("task_requests", "task_latency", "task_errors")
+# queue task-execution metrics are tagged (queue=..., task_type=...);
+# task_outstanding gauges in-flight depth, task_held gauges the parked
+# (DeferTask/retry) depth — the standby planes' hold depth. Replication
+# emits replication_ack_lag (source side, tagged cluster=) plus
+# replication_tasks_applied / replication_apply_latency (consumer side).
+# Reference: common/metrics/defs.go task-type queue + replication scopes.
+QUEUE_METRICS = (
+    "task_requests", "task_latency", "task_errors", "task_outstanding",
+    "task_held",
+)
+REPLICATION_METRICS = (
+    "replication_ack_lag", "replication_tasks_applied",
+    "replication_apply_latency",
+)
 
 # the standard per-operation triple
 REQUESTS = "requests"

@@ -111,6 +111,7 @@ class Harness:
                 ReplicationTaskProcessor(
                     engine.shard, engine.ndc_replicator,
                     self.fetcher, rereplicator=rerepl,
+                    metrics=self.standby.history.metrics,
                 )
             )
 
@@ -285,3 +286,21 @@ def test_standby_defers_tasks_until_failover(xdc):
         )
     )
     assert task is not None, "deferred decision task never dispatched"
+
+
+def test_replication_metrics_emitted(xdc):
+    """VERDICT r4 #6: replication observability — the source side
+    gauges per-cluster ack lag, the consumer side counts applied tasks
+    and times the apply cycle."""
+    run_id = _start(xdc.active, "wf-metrics")
+    applied = xdc.replicate_all()
+    assert applied >= 1
+
+    src = xdc.active.history.metrics.registry.snapshot()
+    lag_keys = [k for k in src["gauges"] if "replication_ack_lag" in k]
+    assert lag_keys and any("cluster" in k for k in lag_keys), src["gauges"]
+
+    dst = xdc.standby.history.metrics.registry
+    assert dst.counter_value("replication_tasks_applied") >= applied
+    count, total, _ = dst.timer_stats("replication_apply_latency")
+    assert count >= 1 and total > 0
