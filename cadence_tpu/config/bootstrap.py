@@ -34,6 +34,7 @@ class RunningServer:
     rpc_servers: Dict[str, object] = dataclasses.field(default_factory=dict)
     pprof: object = None
     failure_detector: object = None
+    bus: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
@@ -134,10 +135,16 @@ def start_services(
             failure_threshold=cfg.ring.failure_threshold,
         ).start()
 
+    from cadence_tpu.messaging import MessageBus
+
     out = RunningServer(
         config=cfg, services=services, persistence=persistence,
         domains=domains, monitor=monitor,
         failure_detector=failure_detector,
+        # messaging plane exists only where the worker runs: a bus on a
+        # frontend/history-only host would make `admin dlq` report an
+        # always-empty queue instead of "no message bus on this host"
+        bus=MessageBus() if "worker" in services else None,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
     # first configured service's port wins, bound on that service's
@@ -210,7 +217,8 @@ def start_services(
             out.domain_handler, domains, hc, mc, visibility=visibility
         )
         out.admin = (
-            AdminHandler(history, domains) if history is not None else None
+            AdminHandler(history, domains, bus=out.bus)
+            if history is not None else None
         )
         out.rpc_servers["frontend"] = FrontendRPCServer(
             out.frontend, out.admin, address=addr("frontend")
@@ -237,6 +245,7 @@ def start_services(
         out.worker = WorkerService(
             worker_frontend, persistence,
             num_shards=cfg.persistence.num_history_shards,
+            bus=out.bus,
             domain_handler=out.domain_handler,
             history_service=history,
         )

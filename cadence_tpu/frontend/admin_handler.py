@@ -16,9 +16,44 @@ from cadence_tpu.utils.hashing import shard_for_workflow
 
 
 class AdminHandler:
-    def __init__(self, history_service, domain_cache) -> None:
+    def __init__(self, history_service, domain_cache, bus=None) -> None:
         self.history = history_service
         self.domains = domain_cache
+        # message bus for DLQ operator verbs (None on hosts that don't
+        # run the messaging plane)
+        self.bus = bus
+
+    # -- DLQ verbs (reference tools/cli/adminDLQCommands.go over
+    # adminHandler Get/Purge/MergeDLQMessages) -------------------------
+
+    def _require_bus(self):
+        if self.bus is None:
+            raise BadRequestError("no message bus on this host")
+        return self.bus
+
+    def read_dlq_messages(
+        self, topic: str, last_message_id: int = -1, count: int = 100,
+    ) -> List[Dict[str, Any]]:
+        msgs = self._require_bus().dlq_read(topic, last_message_id, count)
+        return [
+            {
+                "offset": m.offset,
+                "key": m.key,
+                "value": m.value,
+                "redelivery_count": m.redelivery_count,
+            }
+            for m in msgs
+        ]
+
+    def purge_dlq_messages(
+        self, topic: str, last_message_id: int = -1,
+    ) -> int:
+        return self._require_bus().dlq_purge(topic, last_message_id)
+
+    def merge_dlq_messages(
+        self, topic: str, last_message_id: int = -1,
+    ) -> int:
+        return self._require_bus().dlq_merge(topic, last_message_id)
 
     def describe_history_host(self) -> Dict[str, Any]:
         desc = self.history.describe()

@@ -48,6 +48,18 @@ class _TopicLog:
     def __init__(self, name: str) -> None:
         self.name = name
         self.messages: List[Message] = []
+        # monotonic id source: DLQ purge/merge REMOVE entries, so
+        # len(messages) would recycle ids of surviving dead letters and
+        # corrupt the operator watermark verbs; main topics are
+        # append-only (next_offset == len there)
+        self.next_offset = 0
+
+    def append(self, msg: Message) -> Message:
+        # caller holds the bus lock
+        msg = dataclasses.replace(msg, offset=self.next_offset)
+        self.next_offset += 1
+        self.messages.append(msg)
+        return msg
 
 
 class _GroupState:
@@ -87,8 +99,9 @@ class MessageBus:
     def publish(self, topic: str, key: str, value: Any) -> int:
         with self._lock:
             log = self._topic(topic)
-            msg = Message(topic=topic, key=key, value=value, offset=len(log.messages))
-            log.messages.append(msg)
+            msg = log.append(
+                Message(topic=topic, key=key, value=value, offset=0)
+            )
             self._lock.notify_all()
             return msg.offset
 
@@ -99,6 +112,56 @@ class MessageBus:
     def dlq_messages(self, topic: str) -> List[Message]:
         with self._lock:
             return list(self._topic(topic + self.DLQ_SUFFIX).messages)
+
+    # -- DLQ operator verbs (reference tools/cli/adminDLQCommands.go:
+    # GetDLQMessages / PurgeDLQMessages / MergeDLQMessages with a
+    # lastMessageID watermark; offsets are this bus's message ids) -----
+
+    def dlq_read(
+        self, topic: str, last_offset: int = -1, count: int = 0,
+    ) -> List[Message]:
+        """Dead letters with offset <= last_offset (-1 = all), capped at
+        ``count`` (0 = uncapped)."""
+        with self._lock:
+            msgs = [
+                m for m in self._topic(topic + self.DLQ_SUFFIX).messages
+                if last_offset < 0 or m.offset <= last_offset
+            ]
+        return msgs[:count] if count else msgs
+
+    def dlq_purge(self, topic: str, last_offset: int = -1) -> int:
+        """Drop dead letters up to the watermark; returns count dropped."""
+        with self._lock:
+            dlq = self._topic(topic + self.DLQ_SUFFIX)
+            keep = [
+                m for m in dlq.messages
+                if last_offset >= 0 and m.offset > last_offset
+            ]
+            dropped = len(dlq.messages) - len(keep)
+            dlq.messages[:] = keep
+        return dropped
+
+    def dlq_merge(self, topic: str, last_offset: int = -1) -> int:
+        """Re-drive dead letters up to the watermark back onto the main
+        topic (fresh offsets, redelivery count reset) and drop them from
+        the DLQ; returns count merged."""
+        with self._lock:
+            dlq = self._topic(topic + self.DLQ_SUFFIX)
+            keep: List[Message] = []
+            merged: List[Message] = []
+            for m in dlq.messages:
+                if last_offset < 0 or m.offset <= last_offset:
+                    merged.append(m)
+                else:
+                    keep.append(m)
+            dlq.messages[:] = keep
+            log = self._topic(topic)
+            for m in merged:
+                log.append(dataclasses.replace(
+                    m, topic=topic, redelivery_count=0,
+                ))
+            self._lock.notify_all()
+        return len(merged)
 
     def close(self) -> None:
         with self._lock:
@@ -149,11 +212,9 @@ class MessageBus:
             msg.redelivery_count += 1
             if msg.redelivery_count > self._max_redelivery:
                 dlq = self._topic(topic + self.DLQ_SUFFIX)
-                dlq.messages.append(
-                    dataclasses.replace(
-                        msg, topic=topic + self.DLQ_SUFFIX, offset=len(dlq.messages)
-                    )
-                )
+                dlq.append(dataclasses.replace(
+                    msg, topic=topic + self.DLQ_SUFFIX
+                ))
             else:
                 st.redelivery.append(msg)
             self._lock.notify_all()

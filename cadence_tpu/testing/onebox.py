@@ -65,7 +65,7 @@ class Onebox:
             self.history_client, self.matching_client,
             visibility=self.visibility,
         )
-        self.admin = AdminHandler(self.history, self.domains)
+        self.admin = AdminHandler(self.history, self.domains, bus=self.bus)
         self.worker: Optional[WorkerService] = None
         self._start_worker = start_worker
         self._started = False

@@ -376,6 +376,19 @@ def cmd_tasklist(args) -> None:
     _print(fe.describe_task_list(args.domain, args.name, args.task_type))
 
 
+def _value_size(value) -> int:
+    """Payload size in bytes for any payload shape (dead letters carry
+    whatever the producer published: bytes, str, dict, ...)."""
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    if isinstance(value, str):
+        return len(value.encode())
+    try:
+        return len(json.dumps(value, default=str).encode())
+    except TypeError:
+        return len(repr(value).encode())
+
+
 def cmd_admin(args) -> None:
     fe = _frontend(args)
     if args.admin_cmd == "describe-host":
@@ -391,6 +404,28 @@ def cmd_admin(args) -> None:
         _print(fe.refresh_workflow_tasks(
             args.domain, args.workflow_id, args.run_id or ""
         ))
+    elif args.admin_cmd == "dlq":
+        # reference tools/cli/adminDLQCommands.go read|purge|merge with
+        # a --last-message-id watermark
+        if args.dlq_cmd == "read":
+            msgs = fe.read_dlq_messages(
+                args.topic, args.last_message_id, args.count
+            )
+            _print({"topic": args.topic, "messages": [
+                {
+                    "offset": m["offset"],
+                    "key": m["key"],
+                    "redelivery_count": m["redelivery_count"],
+                    "value_bytes": _value_size(m["value"]),
+                }
+                for m in msgs
+            ]})
+        elif args.dlq_cmd == "purge":
+            n = fe.purge_dlq_messages(args.topic, args.last_message_id)
+            _print({"topic": args.topic, "purged": n})
+        elif args.dlq_cmd == "merge":
+            n = fe.merge_dlq_messages(args.topic, args.last_message_id)
+            _print({"topic": args.topic, "merged": n})
 
 
 def cmd_batch(args) -> None:
@@ -531,6 +566,11 @@ def build_parser() -> argparse.ArgumentParser:
         adw.add_argument("--domain", required=True)
         adw.add_argument("--workflow-id", required=True)
         adw.add_argument("--run-id", default="")
+    adlq = asub.add_parser("dlq", help="dead-letter queue operator verbs")
+    adlq.add_argument("dlq_cmd", choices=("read", "purge", "merge"))
+    adlq.add_argument("--topic", required=True)
+    adlq.add_argument("--last-message-id", type=int, default=-1)
+    adlq.add_argument("--count", type=int, default=100)
     a.set_defaults(fn=cmd_admin)
 
     b = sub.add_parser("batch")
