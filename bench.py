@@ -213,8 +213,16 @@ def measure_copy_bw_gbps(nbytes: int = 1 << 28) -> float:
 
 def _bench_config(config: str, caps, batch: int, iters: int,
                   baseline_histories: int, bt: int, tb: int,
-                  use_pallas: bool):
-    """Returns a per-config result dict."""
+                  use_pallas: bool, chain: int = 1):
+    """Returns a per-config result dict.
+
+    ``chain`` > 1 additionally times ``chain`` kernel executions inside
+    ONE jit dispatch (lax.scan over the replay+refresh step) after the
+    single-dispatch run has proven checksum parity. Through the axon
+    tunnel a dispatch costs ~20ms of rig RTT that production TPU hosts
+    don't pay; the chained number amortizes it to 1/chain and is the
+    honest steady-state device throughput. Both numbers are reported.
+    """
     from cadence_tpu import native
     from cadence_tpu.native import presence_masks
     from cadence_tpu.ops import schema as S
@@ -257,8 +265,10 @@ def _bench_config(config: str, caps, batch: int, iters: int,
 
     # ---- Pallas kernel (field-major events + host presence masks)
     if use_pallas:
-        ev_teb = jnp.asarray(
-            np.ascontiguousarray(np.transpose(events, (1, 2, 0))))
+        from cadence_tpu.ops.replay_pallas import narrow_events_teb
+
+        ev_teb_np = np.ascontiguousarray(np.transpose(events, (1, 2, 0)))
+        ev_teb = jnp.asarray(ev_teb_np)
         valid = events[:, :, S.EV_TYPE] >= 0
         pres = None
         if batch % bt == 0:
@@ -271,6 +281,25 @@ def _bench_config(config: str, caps, batch: int, iters: int,
                 presence=pres)
             return final, refresh_tasks_device(final)
 
+        def _chained(kernel_kwargs):
+            """One jit dispatch running ``chain`` replay+refresh steps
+            (lax.scan) — amortizes the per-dispatch rig RTT. Returns
+            amortized seconds per step."""
+            from jax import lax
+
+            def stepped(state):
+                def body(c, _):
+                    final = replay_scan_pallas_teb(
+                        c, caps=caps, tb=tb, interpret=False, bt=bt,
+                        presence=pres, **kernel_kwargs)
+                    return final, refresh_tasks_device(final)
+
+                return lax.scan(body, state, None, length=chain)
+
+            dt_c, _ = _time_chained(
+                jax.jit(stepped), state0, max(2, iters // 2))
+            return dt_c / chain
+
         try:
             dt_p, cs_p = _time_chained(jax.jit(step_pallas), state0, iters)
             if cs_p != cs_xla:
@@ -280,13 +309,63 @@ def _bench_config(config: str, caps, batch: int, iters: int,
                     "histories_per_sec": round(batch / dt_p, 2),
                     "batch_rebuild_ms": round(dt_p * 1000, 3),
                     "us_per_step": round(dt_p / T * 1e6, 3),
-                    # events are the only per-step HBM traffic (state is
-                    # VMEM-resident); final state flush is amortized
                     "streams_gbps": round(ev_bytes_step / (dt_p / T) / 1e9, 1),
                 }
+                if chain > 1:
+                    per_exec = _chained({"events_teb": ev_teb})
+                    results["pallas"].update({
+                        "chain": chain,
+                        "histories_per_sec_chained": round(
+                            batch / per_exec, 2),
+                        "dispatch_overhead_ms": round(
+                            (dt_p - per_exec) * 1000, 3),
+                    })
         except Exception as exc:  # compile/runtime failure is a reportable
             results["pallas"] = {
                 "error": f"{type(exc).__name__}: {str(exc)[:160]}"}
+
+        # ---- int16 narrow stream: the kernel is event-stream-bound,
+        # so ~halving its bytes is the per-tile lever (r5); parity is
+        # asserted against the XLA checksum before any number is kept
+        if "error" not in results.get("pallas", {"error": 1}):
+            narrowed = narrow_events_teb(ev_teb_np)
+        else:
+            narrowed = None
+        if narrowed is not None:
+            ev16_np, nbase, nwide = narrowed
+            ev16 = jnp.asarray(ev16_np)
+            n16 = {"events_teb": ev16, "base": nbase, "wide_cols": nwide}
+
+            def step_pallas16(state):
+                final = replay_scan_pallas_teb(
+                    state, caps=caps, tb=tb, interpret=False, bt=bt,
+                    presence=pres, **n16)
+                return final, refresh_tasks_device(final)
+
+            try:
+                dt_n, cs_n = _time_chained(
+                    jax.jit(step_pallas16), state0, iters)
+                if cs_n != cs_xla:
+                    results["pallas16"] = {"error": "checksum mismatch"}
+                else:
+                    results["pallas16"] = {
+                        "histories_per_sec": round(batch / dt_n, 2),
+                        "batch_rebuild_ms": round(dt_n * 1000, 3),
+                        "us_per_step": round(dt_n / T * 1e6, 3),
+                        "wide_cols": list(nwide),
+                        "stream_bytes_frac": round(
+                            ev16_np.shape[1] * 2 / (S.EV_N * 4), 3),
+                    }
+                    if chain > 1:
+                        per_exec16 = _chained(n16)
+                        results["pallas16"].update({
+                            "chain": chain,
+                            "histories_per_sec_chained": round(
+                                batch / per_exec16, 2),
+                        })
+            except Exception as exc:
+                results["pallas16"] = {
+                    "error": f"{type(exc).__name__}: {str(exc)[:160]}"}
 
     # ---- compiled-host baseline: C++ sequential replay of the same tensors
     class _Sub:
@@ -305,15 +384,25 @@ def _bench_config(config: str, caps, batch: int, iters: int,
     cpp_s = (time.perf_counter() - t0) / reps
     cpp_rate = nb / cpp_s
 
-    best_key = "pallas" if (
-        "pallas" in results and "histories_per_sec" in results["pallas"]
-    ) else "xla"
+    def _rate(k):
+        # SELECTION compares per-dispatch rates only (every kernel has
+        # one; mixing chained and unchained regimes would let a
+        # dispatch-amortized pallas beat an unchained-but-faster xla)
+        r = results.get(k, {})
+        return r.get("histories_per_sec", -1.0)
+
+    best_key = max(("xla", "pallas", "pallas16"), key=_rate)
     best = results[best_key]
+    # steady-state (dispatch-amortized) rate is the headline when the
+    # chained run exists; the per-dispatch rate stays in "kernels"
+    headline_rate = best.get(
+        "histories_per_sec_chained", best["histories_per_sec"]
+    )
     return {
-        "histories_per_sec": best["histories_per_sec"],
+        "histories_per_sec": headline_rate,
         "kernel": best_key,
         "baseline_cpp_per_sec": round(cpp_rate, 2),
-        "vs_baseline": round(best["histories_per_sec"] / cpp_rate, 2),
+        "vs_baseline": round(headline_rate / cpp_rate, 2),
         "mean_depth": round(mean_depth, 1),
         "batch_rebuild_ms": best["batch_rebuild_ms"],
         "batch": batch,
@@ -411,7 +500,11 @@ def main() -> None:
             continue
         results[config] = _bench_config(
             config, cfg["caps"], cfg["batch"], iters, cfg["baseline"],
-            bt, tb, use_pallas)
+            bt, tb, use_pallas,
+            chain=int(os.environ.get(
+                "BENCH_CHAIN",
+                "4" if (config == "retry_deep" and use_pallas) else "1",
+            )))
 
     head = results["retry_deep"]
     out = {

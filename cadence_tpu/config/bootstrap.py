@@ -108,6 +108,15 @@ def start_services(
     domains = DomainCache(persistence.metadata)
     cluster_metadata = cfg.build_cluster_metadata()
 
+    # dynamic config: file-watched when configured, in-memory otherwise
+    # (ref cmd/server wiring of dynamicconfig fileBasedClient)
+    from cadence_tpu.utils.dynamicconfig import Collection, FileBasedClient
+
+    dyncfg = Collection(
+        FileBasedClient(cfg.dynamicconfig_path)
+        if cfg.dynamicconfig_path else None
+    )
+
     # the host's ring identity per service is its rpc bind address;
     # bootstrap hosts from config pre-populate the rings so a partial
     # host set still routes to its peers
@@ -177,6 +186,11 @@ def start_services(
         history = HistoryService(
             cfg.persistence.num_history_shards, persistence, domains,
             monitor, cluster_metadata=cluster_metadata,
+            # pass the property itself: the file-watched client then
+            # serves runtime edits, not a boot-time snapshot
+            rebuild_chunk_size=dyncfg.int_property(
+                "history.rebuildChunkSize", 0
+            ),
         )
         out.history = history
 
@@ -189,7 +203,7 @@ def start_services(
 
     matching = None
     if "matching" in services:
-        matching = MatchingEngine(persistence.task, hc)
+        matching = MatchingEngine(persistence.task, hc, config=dyncfg)
         out.matching = matching
     mc = RoutedMatchingClient(
         monitor, matching, local_identity=addr("matching")

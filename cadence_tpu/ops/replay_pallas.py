@@ -142,8 +142,9 @@ def rows_to_state(rows, rm: RowMap) -> S.StateTensors:
     )
 
 
-def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int,
-            ablate: int = 0):
+def _kernel(presence_ref, base_ref, ev_ref, init_ref, st, *, rm: RowMap,
+            tb: int, ablate: int = 0, narrow: bool = False,
+            wide_cols: tuple = ()):
     """One (batch-tile, time-block) grid step.
 
     The batch tile is shaped (SL, 128) with SL a multiple of 8 — whole
@@ -194,19 +195,37 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int,
                 out = bit if out is None else out | bit
             return out != 0
 
-        ev = ev_ref[i]  # [EV_N, 1, SL, 128]
-        et = ev[S.EV_TYPE, 0]
+        ev = ev_ref[i]  # [EV_N(phys), 1, SL, 128]
+        if narrow:
+            # int16 stream (narrow_events_teb): affine columns
+            # reconstruct as stored16 + base[c]; wide columns as
+            # (lo16 & 0xffff) | hi16 << 16 — exact int32 either way.
+            # The reconstruction ALU is VPU noise against the stream
+            # the kernel is bound by (module docstring / ablation note)
+            phys, _ = _phys_map(wide_cols)
+
+            def fld(c):
+                p = phys[c]
+                if c in wide_cols:
+                    lo16 = ev[p, 0].astype(jnp.int32) & 0xFFFF
+                    return lo16 | (ev[p + 1, 0].astype(jnp.int32) << 16)
+                return ev[p, 0].astype(jnp.int32) + base_ref[0, c]
+        else:
+            def fld(c):
+                return ev[c, 0]
+
+        et = fld(S.EV_TYPE)
         valid = et >= 0
 
-        ev_id = ev[S.EV_ID, 0]
-        version = ev[S.EV_VERSION, 0]
-        ts = ev[S.EV_TS, 0]
-        batch_first = ev[S.EV_BATCH_FIRST, 0]
-        slot = ev[S.EV_SLOT, 0]
-        a0, a1 = ev[S.EV_A0, 0], ev[S.EV_A1, 0]
-        a2, a3 = ev[S.EV_A2, 0], ev[S.EV_A3, 0]
-        a4, a5 = ev[S.EV_A4, 0], ev[S.EV_A5, 0]
-        a6, a7 = ev[S.EV_A6, 0], ev[S.EV_A7, 0]
+        ev_id = fld(S.EV_ID)
+        version = fld(S.EV_VERSION)
+        ts = fld(S.EV_TS)
+        batch_first = fld(S.EV_BATCH_FIRST)
+        slot = fld(S.EV_SLOT)
+        a0, a1 = fld(S.EV_A0), fld(S.EV_A1)
+        a2, a3 = fld(S.EV_A2), fld(S.EV_A3)
+        a4, a5 = fld(S.EV_A4), fld(S.EV_A5)
+        a6, a7 = fld(S.EV_A6), fld(S.EV_A7)
 
         X = rm.exec0
 
@@ -220,7 +239,7 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int,
             return valid & out
 
         # ---- preamble (stateBuilder.go:134-155)
-        wr(X + S.X_LAST_EVENT_TASK_ID, valid, ev[S.EV_TASK_ID, 0])
+        wr(X + S.X_LAST_EVENT_TASK_ID, valid, fld(S.EV_TASK_ID))
         wr(X + S.X_CUR_VERSION, valid, version)
         wr(X + S.X_NEXT_EVENT_ID, valid, ev_id + 1)
         wr(X + S.X_LAST_FIRST_EVENT_ID, valid, batch_first)
@@ -553,13 +572,73 @@ def _kernel(presence_ref, ev_ref, init_ref, st, *, rm: RowMap, tb: int,
 BT = 4096  # default batch tile = one (32, 128) int32 block per row
 
 
+def _phys_map(wide_cols):
+    """Logical column -> physical int16 column start; wide columns
+    occupy two physical columns (lo16, hi16)."""
+    phys = {}
+    p = 0
+    for c in range(S.EV_N):
+        phys[c] = p
+        p += 2 if c in wide_cols else 1
+    return phys, p
+
+
+def narrow_events_teb(events_teb):
+    """Narrow an int32 [T, EV_N, B] event tensor to an int16 stream.
+
+    The kernel is bound by streaming the event tensor from HBM (the
+    empty-body ablation measures the same wall time as the full FSM —
+    module docstring), so shrinking the stream's bytes is the per-tile
+    throughput lever. Each column whose value span fits int16 is stored
+    affine (``ev - base[c]``, base = column midrange); a wide column
+    (hash-valued attributes, raw timestamps) is stored EXACTLY as two
+    int16 halves (low 16 bits, high 16 bits). The kernel reconstructs
+    exact int32 values either way, so the state output is bit-identical
+    to the int32 path. Typical mix: 1-3 wide columns of 16 -> ~45-50%
+    of the original stream bytes.
+
+    Returns (ev16 [T, P, B] int16, base [EV_N] int32, wide_cols tuple),
+    or None when EV_TYPE/EV_SLOT would be wide (they gate presence
+    masks; enum-bounded in practice) — callers keep the int32 path,
+    correctness never depends on narrowing.
+    """
+    ev = np.asarray(events_teb)
+    lo = ev.min(axis=(0, 2)).astype(np.int64)
+    hi = ev.max(axis=(0, 2)).astype(np.int64)
+    wide_cols = tuple(
+        int(c) for c in range(S.EV_N) if hi[c] - lo[c] > 65000
+    )
+    if S.EV_TYPE in wide_cols or S.EV_SLOT in wide_cols:
+        return None
+    base64 = ((lo + hi) // 2)
+    base64[list(wide_cols)] = 0
+    phys, P = _phys_map(wide_cols)
+    T, _, B = ev.shape
+    out = np.empty((T, P, B), np.int16)
+    v64 = ev.astype(np.int64)
+    for c in range(S.EV_N):
+        p = phys[c]
+        if c in wide_cols:
+            lo16 = v64[:, c, :] & 0xFFFF
+            out[:, p, :] = np.where(
+                lo16 >= 32768, lo16 - 65536, lo16
+            ).astype(np.int16)
+            out[:, p + 1, :] = (ev[:, c, :] >> 16).astype(np.int16)
+        else:
+            out[:, p, :] = (v64[:, c, :] - base64[c]).astype(np.int16)
+    return out, base64.astype(np.int32), wide_cols
+
+
 @functools.partial(jax.jit,
                    static_argnames=("caps", "tb", "interpret", "bt",
-                                    "ablate"))
+                                    "ablate", "wide_cols"))
 def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
                         tb: int, interpret: bool, bt: int = BT,
-                        ablate: int = 0, presence=None):
-    """events_teb: [T, EV_N, B] int32; rows0: [R, B]. Returns [R, B].
+                        ablate: int = 0, presence=None, base=None,
+                        wide_cols: tuple = ()):
+    """events_teb: [T, EV_N, B] int32 — or the int16 narrow stream from
+    ``narrow_events_teb`` (physical layout, with ``base`` [EV_N] int32
+    and the static ``wide_cols`` tuple); rows0: [R, B]. Returns [R, B].
 
     B must be a multiple of ``bt``; each batch tile is viewed as
     (bt//128, 128). ``tb * EV_N * bt * 4`` bytes of events are VMEM-
@@ -571,6 +650,9 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
             f"bt={bt} must be a multiple of 1024: each batch tile is viewed "
             "as (bt//128, 128) and bt//128 must be a multiple of 8 (whole "
             "int32 VPU tiles, the kernel's layout assumption)")
+    narrow = events_teb.dtype == jnp.int16
+    if narrow and base is None:
+        raise ValueError("int16 events need their affine base vector")
     rm = RowMap(caps)
     sl = bt // 128
     T, ev_n, B = events_teb.shape
@@ -578,6 +660,9 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
     n_bt = B // bt
     ev5 = events_teb.reshape(T, ev_n, n_bt, sl, 128)
     rows5 = rows0.reshape(R, n_bt, sl, 128)
+    if base is None:
+        base = jnp.zeros((ev_n,), jnp.int32)
+    base2 = jnp.asarray(base, jnp.int32)[None, :]
 
     if presence is None:
         # per-(step, tile) event-type presence bitmask, computed in
@@ -585,11 +670,16 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
         # from SMEM. Callers that pack host-side pass it precomputed
         # (PackedHistories.presence) — the XLA reduction over the full
         # event tensor is a measurable share of replay time.
-        et = ev5[:, S.EV_TYPE]  # [T, n_bt, sl, 128]
+        phys, _ = _phys_map(wide_cols) if narrow else ({c: c for c in
+                                                        range(S.EV_N)}, 0)
+        et = ev5[:, phys[S.EV_TYPE]].astype(jnp.int32)
+        slot_v = ev5[:, phys[S.EV_SLOT]].astype(jnp.int32)
+        if narrow:
+            et = et + base2[0, S.EV_TYPE]
+            slot_v = slot_v + base2[0, S.EV_SLOT]
         et_valid = et >= 0
         word = jnp.where(et_valid, et // 32, 0)
         bit = jnp.where(et_valid, jnp.left_shift(1, et % 32), 0)
-        slot_v = ev5[:, S.EV_SLOT]  # [T, n_bt, sl, 128]
         slot_ok = et_valid & (slot_v >= 0)
         slot_bit = jnp.where(slot_ok, jnp.left_shift(1, slot_v % 32), 0)
         words = [
@@ -607,11 +697,14 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
 
     grid = (n_bt, T // tb)
     out = pl.pallas_call(
-        functools.partial(_kernel, rm=rm, tb=tb, ablate=ablate),
+        functools.partial(_kernel, rm=rm, tb=tb, ablate=ablate,
+                          narrow=narrow, wide_cols=wide_cols),
         out_shape=jax.ShapeDtypeStruct((R, n_bt, sl, 128), jnp.int32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, tb, 4), lambda b, t: (b, t, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, S.EV_N), lambda b, t: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((tb, ev_n, 1, sl, 128),
                          lambda b, t: (t, 0, b, 0, 0),
@@ -626,7 +719,7 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(presence, ev5, rows5)
+    )(presence, base2, ev5, rows5)
     return out.reshape(R, B)
 
 
@@ -639,27 +732,41 @@ def replay_scan_pallas_teb(
     bt: int = BT,
     ablate: int = 0,
     presence=None,
+    base=None,
+    wide_cols: tuple = (),
 ) -> S.StateTensors:
     """Replay on the Pallas kernel from the field-major event layout.
 
     events_teb: [T, EV_N, B] (``PackedHistories.teb()``) — the kernel's
     native operand layout; no device-side transpose happens here, which
     matters: at large B transposing the event tensor costs more HBM
-    traffic than the entire replay scan. Pads B to a multiple of ``bt``
-    (invalid events + empty state) and T to a multiple of ``tb``
-    (invalid events are no-ops).
+    traffic than the entire replay scan. May be int16 with ``base``
+    [EV_N] int32 (the affine narrow stream from ``narrow_events_teb`` —
+    halves the HBM traffic the kernel is bound by). Pads B to a
+    multiple of ``bt`` (invalid events + empty state) and T to a
+    multiple of ``tb`` (invalid events are no-ops).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     events_teb = jnp.asarray(events_teb)
+    narrow = events_teb.dtype == jnp.int16
     T, ev_n, B = events_teb.shape
     rm = RowMap(caps)
     b_pad = (-B) % bt
     t_pad = (-T) % tb
 
     if t_pad or b_pad:
-        fill = jnp.zeros((t_pad + T, ev_n, B + b_pad), jnp.int32)
-        fill = fill.at[:, S.EV_TYPE, :].set(-1)
+        if narrow:
+            # padding must reconstruct EV_TYPE == -1 through the base;
+            # wide columns pad as 0 halves (reconstruct 0, and invalid
+            # rows never read past the type anyway)
+            phys, _ = _phys_map(wide_cols)
+            pad_type = jnp.int16(-1 - int(np.asarray(base)[S.EV_TYPE]))
+            fill = jnp.zeros((t_pad + T, ev_n, B + b_pad), jnp.int16)
+            fill = fill.at[:, phys[S.EV_TYPE], :].set(pad_type)
+        else:
+            fill = jnp.zeros((t_pad + T, ev_n, B + b_pad), jnp.int32)
+            fill = fill.at[:, S.EV_TYPE, :].set(-1)
         events_teb = fill.at[:T, :, :B].set(events_teb)
 
     if presence is not None:
@@ -678,7 +785,8 @@ def replay_scan_pallas_teb(
         )
 
     rows = _replay_rows_pallas(events_teb, rows0, caps, tb, interpret, bt,
-                               ablate, presence)
+                               ablate, presence, base,
+                               wide_cols=tuple(wide_cols))
     return rows_to_state(rows[:, :B], rm)
 
 

@@ -1141,6 +1141,9 @@ class HistoryEngine:
                 task_notifier=self._task_notifier,
                 timer_notifier=self._timer_notifier,
             )
+            configured = getattr(self, "rebuild_chunk_size", 0)
+            if configured:
+                self._ndc_replicator.rebuilder.chunk_size = configured
         return self._ndc_replicator
 
     @property

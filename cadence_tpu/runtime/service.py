@@ -41,6 +41,7 @@ class HistoryService:
         queue_worker_count: int = 4,
         cluster_metadata=None,
         metrics=None,
+        rebuild_chunk_size: int = 0,
     ) -> None:
         from cadence_tpu.utils.metrics import Scope
 
@@ -55,6 +56,9 @@ class HistoryService:
         # task-type scopes); a real registry by default so canary/tests
         # can assert on it via service.metrics.registry
         self.metrics = metrics if metrics is not None else Scope()
+        # rebuild_many device-chunk rows; 0 = backend-resolved default
+        # (dynamicconfig history.rebuildChunkSize via bootstrap)
+        self.rebuild_chunk_size = rebuild_chunk_size
         self._log = get_logger(
             "cadence_tpu.history.service", host=monitor.self_identity
         )
@@ -99,6 +103,7 @@ class HistoryService:
         engine = HistoryEngine(shard, self.domains)
         engine.cluster_metadata = self.cluster_metadata
         engine.metrics = self.metrics
+        engine.rebuild_chunk_size = self.rebuild_chunk_size
         engine.matching_client = self.matching_client
         has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(

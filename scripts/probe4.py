@@ -68,6 +68,9 @@ def main():
     ap.add_argument("--ablate", default="0",
                     help="comma list of kernel ablation levels for --teb "
                          "(0=full FSM .. 5=empty body)")
+    ap.add_argument("--narrow", action="store_true",
+                    help="also run the int16 narrow event stream "
+                         "(narrow_events_teb) next to the int32 teb run")
     ap.add_argument("--chain", type=int, default=1,
                     help="wrap the kernel in a lax.scan of K dependent "
                          "iterations inside ONE jit dispatch — separates "
@@ -131,9 +134,12 @@ def main():
 
         if args.teb:
             from cadence_tpu.native import presence_masks
-            from cadence_tpu.ops.replay_pallas import replay_scan_pallas_teb
-            ev_teb = jnp.asarray(np.ascontiguousarray(
-                np.transpose(events, (1, 2, 0))))
+            from cadence_tpu.ops.replay_pallas import (
+                narrow_events_teb,
+                replay_scan_pallas_teb,
+            )
+            ev_teb_np = np.ascontiguousarray(np.transpose(events, (1, 2, 0)))
+            ev_teb = jnp.asarray(ev_teb_np)
             pres = None
             if args.host_presence and batch % args.bt == 0:
                 rows_cat = events.reshape(-1, S.EV_N)
@@ -173,6 +179,50 @@ def main():
                     print(f"  B={batch:6d} teb a{ab} FAILED: "
                           f"{type(exc).__name__}: {str(exc)[:300]}",
                           flush=True)
+
+            if args.narrow:
+                narrowed = narrow_events_teb(ev_teb_np)
+                if narrowed is None:
+                    print(f"  B={batch:6d} n16 REFUSED (TYPE/SLOT wide)",
+                          flush=True)
+                else:
+                    ev16_np, nbase, nwide = narrowed
+                    ev16 = jnp.asarray(ev16_np)
+                    frac = ev16_np.shape[1] * 2 / (S.EV_N * 4)
+                    if args.chain > 1:
+                        from jax import lax as _lax
+
+                        def f16(s, e):
+                            def body(c, _):
+                                return replay_scan_pallas_teb(
+                                    c, e, caps, tb=args.tb,
+                                    interpret=False, bt=args.bt,
+                                    presence=pres, base=nbase,
+                                    wide_cols=nwide), None
+
+                            return _lax.scan(body, s, None,
+                                             length=args.chain)[0]
+
+                        f16 = jax.jit(f16)
+                    else:
+                        f16 = jax.jit(
+                            lambda s, e: replay_scan_pallas_teb(
+                                s, e, caps, tb=args.tb, interpret=False,
+                                bt=args.bt, presence=pres, base=nbase,
+                                wide_cols=nwide))
+                    try:
+                        dt, v = timeit(f16, state0, ev16, args.iters)
+                        dt = dt / max(1, args.chain)
+                        tag = "n16" + (
+                            f"x{args.chain}" if args.chain > 1 else "")
+                        print(f"  B={batch:6d} teb {tag} {dt*1e3:7.2f} ms  "
+                              f"{dt/T*1e6:8.2f} us/step  "
+                              f"{batch/dt:12.0f} hist/s  "
+                              f"bytes={frac:.2f}x  cs={v}", flush=True)
+                    except Exception as exc:
+                        print(f"  B={batch:6d} teb n16 FAILED: "
+                              f"{type(exc).__name__}: {str(exc)[:300]}",
+                              flush=True)
 
         if args.pallas:
             f = jax.jit(lambda s, e: replay_scan_pallas(

@@ -3,22 +3,25 @@
 # Sequential (single chip, single host core). Each step writes its own
 # log under scripts/out/ so partial sessions still leave a record if the
 # tunnel dies mid-way.
-# 1) batch scaling        -> fixed-vs-marginal cost split
-# 2) dispatch-chain test  -> how much of the fixed cost is per-dispatch
-# 3) ablation sweep       -> where FSM compute goes
-# 4) full bench           -> honest headline + warms the compile cache
+# 0) smoke            -> shipped-defaults compile + parity (committed jsonl)
+# 1) batch scaling    -> fixed-vs-marginal cost split, int32 + int16 streams
+# 2) dispatch-chain   -> how much of the fixed cost is per-dispatch RTT
+# 3) ablation sweep   -> where FSM compute goes (a5 == stream floor)
+# 4) full bench       -> honest headline + warms the compile cache
 set -x
 cd "$(dirname "$0")/.."
 OUT=scripts/out
 mkdir -p "$OUT"
 
+timeout 900 python scripts/tpu_smoke.py > "$OUT/smoke_r5.log" 2>&1
+
 timeout 1800 python scripts/probe4.py --config retry_deep \
-    --batches 8192,32768,131072 --teb --host-presence \
+    --batches 8192,32768,131072 --teb --host-presence --narrow \
     --bt 8192 --tb 16 --iters 5 > "$OUT/scaling_r5.log" 2>&1
 
-timeout 1200 python scripts/probe4.py --config retry_deep \
-    --batches 65536 --teb --host-presence --bt 8192 --tb 16 \
-    --iters 3 --chain 4 > "$OUT/chain_r5.log" 2>&1
+timeout 1500 python scripts/probe4.py --config retry_deep \
+    --batches 65536 --teb --host-presence --narrow \
+    --bt 8192 --tb 16 --iters 3 --chain 4 > "$OUT/chain_r5.log" 2>&1
 
 timeout 2400 python scripts/probe4.py --config retry_deep \
     --batches 65536 --teb --host-presence --bt 8192 --tb 16 \

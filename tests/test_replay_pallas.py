@@ -177,3 +177,99 @@ def test_parity_fast():
     # masks (None would fall back to the on-device computation)
     _parity(hs, tb=8, bt=1024, caps=FAST_CAPS, use_teb=True,
             pad_batch_to=1024)
+
+
+def test_parity_narrow_int16():
+    """The affine int16 event stream must produce a BIT-IDENTICAL state
+    to the int32 path (the kernel reconstructs exact values as
+    stored16 + base[c]); the kernel is stream-bound, so this is the
+    per-tile throughput lever (r5)."""
+    from cadence_tpu.ops.replay_pallas import (
+        narrow_events_teb,
+        replay_scan_pallas_teb,
+    )
+
+    fz = HistoryFuzzer(seed=5, caps=FAST_CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}", fz.generate(target_events=12))
+        for i in range(4)
+    ]
+    packed = pack_histories(hs, caps=FAST_CAPS, pad_batch_to=1024)
+    b = packed.events.shape[0]
+    ev_tm = jnp.asarray(
+        np.ascontiguousarray(np.transpose(packed.events, (1, 0, 2)))
+    )
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(b, FAST_CAPS)
+    )
+    want = replay_scan(state0, ev_tm)
+
+    teb = packed.teb()
+    narrowed = narrow_events_teb(teb)
+    assert narrowed is not None, "TYPE/SLOT unexpectedly wide"
+    ev16, base, wide_cols = narrowed
+    assert ev16.dtype == np.int16
+    # the fuzzed workload carries at least one hash-valued attribute
+    # column, so the two-half wide path is exercised
+    assert wide_cols, "expected at least one wide column"
+    got = replay_scan_pallas_teb(
+        state0, jnp.asarray(ev16), FAST_CAPS, tb=8, interpret=True,
+        bt=1024, presence=packed.presence(1024), base=base,
+        wide_cols=wide_cols,
+    )
+    _assert_state_equal(got, want)
+
+
+def test_parity_narrow_int16_with_padding():
+    """Narrow path through the B/T padding branch (pad fill must
+    reconstruct EV_TYPE == -1 through the base)."""
+    from cadence_tpu.ops.replay_pallas import (
+        narrow_events_teb,
+        replay_scan_pallas_teb,
+    )
+
+    fz = HistoryFuzzer(seed=6, caps=FAST_CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}", fz.generate(target_events=10))
+        for i in range(3)
+    ]
+    packed = pack_histories(hs, caps=FAST_CAPS)
+    b = packed.events.shape[0]
+    ev_tm = jnp.asarray(
+        np.ascontiguousarray(np.transpose(packed.events, (1, 0, 2)))
+    )
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(b, FAST_CAPS)
+    )
+    want = replay_scan(state0, ev_tm)
+    ev16, base, wide_cols = narrow_events_teb(packed.teb())
+    got = replay_scan_pallas_teb(
+        state0, jnp.asarray(ev16), FAST_CAPS, tb=8, interpret=True,
+        bt=1024, base=base, wide_cols=wide_cols,
+    )
+    _assert_state_equal(got, want)
+
+
+def test_narrow_wide_columns_split_exactly():
+    """A column whose value span exceeds int16 is stored as two exact
+    halves, not refused; TYPE/SLOT going wide refuses narrowing."""
+    from cadence_tpu.ops.replay_pallas import _phys_map, narrow_events_teb
+
+    ev = np.zeros((4, S.EV_N, 8), np.int32)
+    ev[:, S.EV_TYPE, :] = 1
+    ev[1, S.EV_A0, 0] = 70000        # span > 65000 -> wide
+    ev[2, S.EV_A0, 1] = -123456789   # negative wide value
+    ev16, base, wide_cols = narrow_events_teb(ev)
+    assert S.EV_A0 in wide_cols
+    phys, P = _phys_map(wide_cols)
+    assert ev16.shape[1] == P
+    p = phys[S.EV_A0]
+    lo = ev16[:, p, :].astype(np.int64) & 0xFFFF
+    rebuilt = (lo | (ev16[:, p + 1, :].astype(np.int64) << 16)).astype(
+        np.int32)
+    np.testing.assert_array_equal(rebuilt, ev[:, S.EV_A0, :])
+
+    # TYPE wide -> refuse
+    ev2 = np.zeros((2, S.EV_N, 4), np.int32)
+    ev2[0, S.EV_TYPE, 0] = 100000
+    assert narrow_events_teb(ev2) is None
