@@ -23,6 +23,17 @@ class AdminHandler:
         # run the messaging plane)
         self.bus = bus
 
+    def describe_queue_states(self, shard_id: int) -> Dict[str, Any]:
+        """Per-queue cursor/depth introspection for one owned shard
+        (reference tools/cli/adminQueueCommands.go DescribeQueue) —
+        collection lives on HistoryService, next to describe()."""
+        try:
+            return self.history.describe_queue_states(shard_id)
+        except KeyError:
+            raise EntityNotExistsServiceError(
+                f"shard {shard_id} is not owned by this host"
+            )
+
     # -- DLQ verbs (reference tools/cli/adminDLQCommands.go over
     # adminHandler Get/Purge/MergeDLQMessages) -------------------------
 

@@ -50,8 +50,16 @@ class DeviceDispatcher:
         caps: Optional[S.Capacities] = None,
         depth: int = 2,
         kernel: str = "auto",
+        narrow: bool = True,
     ) -> None:
         self.caps = caps or S.Capacities()
+        # int16 narrow event stream (replay_pallas.narrow_events_teb):
+        # halves both the H2D transfer and the HBM stream the kernel is
+        # bound by; falls back per batch when a gating column is wide.
+        # The wide set only GROWS across batches (passed as force_wide)
+        # so the kernel specialization key stays stable mid-storm
+        self.narrow = narrow
+        self._wide_set: set = set()
         self._in: "queue.Queue" = queue.Queue()
         self._staged: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._out: "queue.Queue" = queue.Queue()
@@ -111,8 +119,23 @@ class DeviceDispatcher:
             batch_id, histories = item
             try:
                 packed = pack_histories(histories, caps=self.caps)
+                narrow_meta = None
                 if use_pallas:
-                    events = jax.device_put(jnp.asarray(packed.teb()))
+                    teb = packed.teb()
+                    narrowed = None
+                    if self.narrow:
+                        from .replay_pallas import narrow_events_teb
+
+                        narrowed = narrow_events_teb(
+                            teb, force_wide=tuple(sorted(self._wide_set))
+                        )
+                    if narrowed is not None:
+                        ev16, nbase, nwide = narrowed
+                        self._wide_set.update(nwide)
+                        events = jax.device_put(jnp.asarray(ev16))
+                        narrow_meta = (nbase, nwide)
+                    else:
+                        events = jax.device_put(jnp.asarray(teb))
                 else:
                     events = jax.device_put(
                         jnp.asarray(packed.time_major())
@@ -123,7 +146,9 @@ class DeviceDispatcher:
                 )
                 # blocks when `depth` batches are already staged — the
                 # double-buffer backpressure
-                self._staged.put((batch_id, packed, events, state0))
+                self._staged.put(
+                    (batch_id, packed, events, narrow_meta, state0)
+                )
             except Exception as e:
                 self._staged.put(DispatchError(batch_id, e))
 
@@ -137,13 +162,18 @@ class DeviceDispatcher:
             if isinstance(item, DispatchError):
                 self._out.put(item)
                 continue
-            batch_id, packed, events, state0 = item
+            batch_id, packed, events, narrow_meta, state0 = item
             try:
                 if use_pallas:
                     from .replay_pallas import replay_scan_pallas_teb
 
+                    nbase, nwide = (
+                        narrow_meta if narrow_meta is not None
+                        else (None, ())
+                    )
                     final = replay_scan_pallas_teb(
-                        state0, events, self.caps
+                        state0, events, self.caps, base=nbase,
+                        wide_cols=nwide,
                     )
                 else:
                     from .replay import replay_scan_jit

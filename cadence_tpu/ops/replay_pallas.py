@@ -583,7 +583,7 @@ def _phys_map(wide_cols):
     return phys, p
 
 
-def narrow_events_teb(events_teb):
+def narrow_events_teb(events_teb, force_wide=()):
     """Narrow an int32 [T, EV_N, B] event tensor to an int16 stream.
 
     The kernel is bound by streaming the event tensor from HBM (the
@@ -597,6 +597,12 @@ def narrow_events_teb(events_teb):
     to the int32 path. Typical mix: 1-3 wide columns of 16 -> ~45-50%
     of the original stream bytes.
 
+    ``force_wide``: columns stored wide regardless of this tensor's
+    span. Repeat callers (the serving dispatcher) pass their running
+    union so the static wide set — a jit/Mosaic specialization key —
+    grows monotonically instead of flapping per batch, which would
+    recompile the kernel mid-storm.
+
     Returns (ev16 [T, P, B] int16, base [EV_N] int32, wide_cols tuple),
     or None when EV_TYPE/EV_SLOT would be wide (they gate presence
     masks; enum-bounded in practice) — callers keep the int32 path,
@@ -605,9 +611,9 @@ def narrow_events_teb(events_teb):
     ev = np.asarray(events_teb)
     lo = ev.min(axis=(0, 2)).astype(np.int64)
     hi = ev.max(axis=(0, 2)).astype(np.int64)
-    wide_cols = tuple(
+    wide_cols = tuple(sorted(set(
         int(c) for c in range(S.EV_N) if hi[c] - lo[c] > 65000
-    )
+    ) | set(int(c) for c in force_wide)))
     if S.EV_TYPE in wide_cols or S.EV_SLOT in wide_cols:
         return None
     base64 = ((lo + hi) // 2)

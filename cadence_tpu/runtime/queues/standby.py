@@ -392,6 +392,7 @@ class TimerQueueStandbyProcessor:
         self.engine = engine
         self.cluster = cluster
         self._on_handover = on_handover
+        self.name = f"timer-standby-{cluster}-{shard.shard_id}"
         self._log = get_logger(
             "cadence_tpu.queue.timer-standby",
             shard=shard.shard_id, cluster=cluster,

@@ -50,6 +50,7 @@ class TimerQueueProcessor:
         self.matching = matching
         self.standby_clusters = frozenset(standby_clusters)
         self.has_standby = bool(self.standby_clusters)
+        self.name = f"timer-{shard.shard_id}"
         self._log = get_logger("cadence_tpu.queue.timer", shard=shard.shard_id)
         self._metrics = (metrics or NOOP).tagged(
             service="history_queue", queue=f"timer-{shard.shard_id}"

@@ -218,6 +218,34 @@ class HistoryService:
     def describe(self) -> dict:
         return self.controller.describe()
 
+    def describe_queue_states(self, shard_id: int) -> dict:
+        """Per-queue cursor/depth view of one owned shard (reference
+        tools/cli/adminQueueCommands.go DescribeQueue): each processor's
+        ack level plus in-flight and parked (standby hold) depths — the
+        operator view of a wedged ack sweep. Raises KeyError for a
+        shard this host doesn't own (AdminHandler maps to 404)."""
+        with self.controller._lock:
+            handle = self.controller._handles.get(shard_id)
+        if handle is None:
+            raise KeyError(shard_id)
+
+        def _level(v):
+            return list(v) if isinstance(v, tuple) else v
+
+        queues = []
+        for p in handle.processors:
+            ack = getattr(p, "ack", None)
+            if ack is None:
+                continue  # e.g. QueueGC / replication consumers
+            queues.append({
+                "queue": getattr(p, "name", type(p).__name__),
+                "ack_level": _level(ack.ack_level),
+                "read_level": _level(ack.read_level),
+                "outstanding": ack.outstanding(),
+                "held": ack.held(),
+            })
+        return {"shard_id": shard_id, "queues": queues}
+
     def drain_queues(self, timeout_s: float = 10.0) -> bool:
         """Wait until every owned shard's queues are quiescent (tests)."""
         ok = True

@@ -404,6 +404,9 @@ def cmd_admin(args) -> None:
         _print(fe.refresh_workflow_tasks(
             args.domain, args.workflow_id, args.run_id or ""
         ))
+    elif args.admin_cmd == "queue-state":
+        # reference tools/cli/adminQueueCommands.go DescribeQueue
+        _print(fe.describe_queue_states(args.shard_id))
     elif args.admin_cmd == "dlq":
         # reference tools/cli/adminDLQCommands.go read|purge|merge with
         # a --last-message-id watermark
@@ -566,6 +569,9 @@ def build_parser() -> argparse.ArgumentParser:
         adw.add_argument("--domain", required=True)
         adw.add_argument("--workflow-id", required=True)
         adw.add_argument("--run-id", default="")
+    aqs = asub.add_parser("queue-state",
+                          help="per-queue cursors/depths of one shard")
+    aqs.add_argument("--shard-id", type=int, required=True)
     adlq = asub.add_parser("dlq", help="dead-letter queue operator verbs")
     adlq.add_argument("dlq_cmd", choices=("read", "purge", "merge"))
     adlq.add_argument("--topic", required=True)

@@ -134,3 +134,27 @@ def test_dlq_offsets_monotonic_after_purge(served):
     new_offs = [m.offset for m in box.bus.dlq_messages(TOPIC)]
     assert new_offs[0] == offs[1]
     assert new_offs[1] > offs[1], new_offs
+
+
+def test_admin_queue_state(served, capsys):
+    """`admin queue-state` exposes every queue processor's cursors and
+    depths for an owned shard (ref adminQueueCommands.go DescribeQueue),
+    and 404s an unowned shard."""
+    import pytest as _pytest
+
+    from cadence_tpu.runtime.api import EntityNotExistsServiceError
+
+    box, addr = served
+    cmd_admin(argparse.Namespace(
+        address=addr, admin_cmd="queue-state", shard_id=0))
+    out = json.loads(capsys.readouterr().out)
+    assert out["shard_id"] == 0
+    names = [q["queue"] for q in out["queues"]]
+    assert any(n.startswith("transfer-") for n in names), names
+    assert any(n.startswith("timer-") for n in names), names
+    for q in out["queues"]:
+        assert "ack_level" in q and "outstanding" in q and "held" in q
+
+    with _pytest.raises(EntityNotExistsServiceError):
+        cmd_admin(argparse.Namespace(
+            address=addr, admin_cmd="queue-state", shard_id=99))
