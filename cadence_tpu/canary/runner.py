@@ -41,6 +41,10 @@ def run_canary(
 
             box = Onebox(num_shards=4).start()
             frontend = box.frontend
+            if keep_box is not None:
+                # hand the embedded box to the caller (tests read its
+                # metrics registry after the run)
+                keep_box.box = box
     try:
         try:
             frontend.register_domain(CANARY_DOMAIN, retention_days=1)

@@ -321,3 +321,40 @@ def test_failure_detector_evicts_then_readmits():
     alive["hostB"] = True
     det.probe_once()
     assert ring() == ["hostA", "hostB"]  # re-admitted on first answer
+
+
+def test_routed_retry_predicate_branches():
+    """is_routed_retryable must cover every transient the failover
+    window can produce: both ShardOwnershipLost shapes (controller's
+    and the persistence rangeID-fencing sibling), UNAVAILABLE and
+    CANCELLED rpc errors (the latter = stub cache closed a channel
+    mid-call), the closed-channel ValueError, and a momentarily-empty
+    ring — and nothing else."""
+    import grpc
+
+    from cadence_tpu.client.routed import is_routed_retryable
+    from cadence_tpu.runtime.controller import ShardOwnershipLostError
+    from cadence_tpu.runtime.persistence.errors import (
+        ShardOwnershipLostError as PersistenceSOL,
+    )
+
+    class _Rpc(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    assert is_routed_retryable(ShardOwnershipLostError(1, "other"))
+    assert is_routed_retryable(PersistenceSOL("fenced"))
+    assert is_routed_retryable(ConnectionError("refused"))
+    assert is_routed_retryable(_Rpc(grpc.StatusCode.UNAVAILABLE))
+    assert is_routed_retryable(_Rpc(grpc.StatusCode.CANCELLED))
+    assert is_routed_retryable(
+        ValueError("Cannot invoke RPC on closed channel!"))
+    assert is_routed_retryable(
+        RuntimeError("no hosts in service ring 'history'"))
+
+    assert not is_routed_retryable(_Rpc(grpc.StatusCode.INVALID_ARGUMENT))
+    assert not is_routed_retryable(ValueError("bad request"))
+    assert not is_routed_retryable(RuntimeError("boom"))
