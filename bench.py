@@ -327,10 +327,8 @@ def _bench_config(config: str, caps, batch: int, iters: int,
         # ---- int16 narrow stream: the kernel is event-stream-bound,
         # so ~halving its bytes is the per-tile lever (r5); parity is
         # asserted against the XLA checksum before any number is kept
-        if "error" not in results.get("pallas", {"error": 1}):
-            narrowed = narrow_events_teb(ev_teb_np)
-        else:
-            narrowed = None
+        pallas_ok = "histories_per_sec" in results.get("pallas", {})
+        narrowed = narrow_events_teb(ev_teb_np) if pallas_ok else None
         if narrowed is not None:
             ev16_np, nbase, nwide = narrowed
             ev16 = jnp.asarray(ev16_np)

@@ -621,17 +621,19 @@ def narrow_events_teb(events_teb, force_wide=()):
     phys, P = _phys_map(wide_cols)
     T, _, B = ev.shape
     out = np.empty((T, P, B), np.int16)
-    v64 = ev.astype(np.int64)
+    # stage one column at a time: a whole-tensor int64 copy would be a
+    # transient 2x the event tensor (gigabytes at serving chunk sizes)
     for c in range(S.EV_N):
         p = phys[c]
+        col = ev[:, c, :].astype(np.int64)
         if c in wide_cols:
-            lo16 = v64[:, c, :] & 0xFFFF
+            lo16 = col & 0xFFFF
             out[:, p, :] = np.where(
                 lo16 >= 32768, lo16 - 65536, lo16
             ).astype(np.int16)
             out[:, p + 1, :] = (ev[:, c, :] >> 16).astype(np.int16)
         else:
-            out[:, p, :] = (v64[:, c, :] - base64[c]).astype(np.int16)
+            out[:, p, :] = (col - base64[c]).astype(np.int16)
     return out, base64.astype(np.int32), wide_cols
 
 

@@ -201,6 +201,7 @@ class FailureDetector:
         self._evicted: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._pool = None  # lazy: probe rounds reuse one executor
 
     def start(self) -> "FailureDetector":
         self._thread = threading.Thread(
@@ -213,6 +214,8 @@ class FailureDetector:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
 
     def _run(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
@@ -226,8 +229,6 @@ class FailureDetector:
         (test-callable). Probes run concurrently so one blackholed host
         cannot stretch the round by its full timeout per peer; ring
         mutations happen after the round, on this thread."""
-        from concurrent.futures import ThreadPoolExecutor
-
         targets = []  # (service, identity, currently_evicted)
         for service in self.services:
             for host in self.monitor.resolver(service).members():
@@ -236,10 +237,15 @@ class FailureDetector:
         targets.extend((s, i, True) for (s, i) in self._evicted)
         if not targets:
             return
-        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
-            alive = list(pool.map(
-                lambda t: self.probe(t[0], t[1]), targets
-            ))
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="fd-probe"
+            )
+        alive = list(self._pool.map(
+            lambda t: self.probe(t[0], t[1]), targets
+        ))
         for (service, ident, evicted), ok in zip(targets, alive):
             key = (service, ident)
             if ok:
