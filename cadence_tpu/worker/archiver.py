@@ -26,7 +26,7 @@ from cadence_tpu.runtime.api import (
     StartWorkflowRequest,
 )
 
-from .sdk import Worker
+from .sdk import ActivityError, Worker
 
 SYSTEM_DOMAIN = "cadence-system"
 ARCHIVAL_WORKFLOW_TYPE = "cadence-sys-archival-workflow"
@@ -96,13 +96,34 @@ class ArchivalClient:
         )
 
 
+# transient store errors must not fail the system run: one poisoned
+# upload would kill every other buffered request on it (the reference
+# retries archival activities with an unlimited-attempt policy,
+# service/worker/archiver/activities.go)
+_ARCHIVE_RETRY = {
+    "initial_interval_seconds": 2,
+    "backoff_coefficient": 2.0,
+    "maximum_interval_seconds": 60,
+    "maximum_attempts": 10,
+}
+
+
 def _archive_one(ctx, payload):
-    yield ctx.schedule_activity(
-        "upload_history", payload, start_to_close_timeout_seconds=300,
-    )
-    yield ctx.schedule_activity(
-        "archive_visibility", payload, start_to_close_timeout_seconds=60,
-    )
+    try:
+        yield ctx.schedule_activity(
+            "upload_history", payload,
+            start_to_close_timeout_seconds=300,
+            retry_policy=_ARCHIVE_RETRY,
+        )
+        yield ctx.schedule_activity(
+            "archive_visibility", payload,
+            start_to_close_timeout_seconds=60,
+            retry_policy=_ARCHIVE_RETRY,
+        )
+    except ActivityError:
+        # retry budget exhausted for THIS request: drop it, keep the
+        # pump alive for the other buffered requests
+        pass
 
 
 def archival_workflow(ctx, input: bytes):

@@ -12,8 +12,9 @@ import json
 from typing import List, Optional
 
 from cadence_tpu.runtime.api import SignalRequest
+from cadence_tpu.utils.quotas import TokenBucket
 
-from .sdk import Worker
+from .sdk import Worker, activity_heartbeat
 from .archiver import SYSTEM_DOMAIN
 
 BATCHER_WORKFLOW_TYPE = "cadence-sys-batch-workflow"
@@ -24,6 +25,7 @@ def batch_workflow(ctx, input: bytes):
     """input: json {operation, domain, query|executions, params}."""
     summary = yield ctx.schedule_activity(
         "run_batch", input, start_to_close_timeout_seconds=3600,
+        heartbeat_timeout_seconds=120,
     )
     return summary
 
@@ -32,17 +34,26 @@ class BatcherActivities:
     def __init__(self, frontend) -> None:
         self.frontend = frontend
 
+    # per-activity RPS cap (reference batcher DefaultRPS); burst 1 makes
+    # the cap a hard pace, not a front-loaded burst
+    DEFAULT_RPS = 50.0
+
     def run_batch(self, payload: bytes) -> bytes:
+        import time as _time
+
         req = json.loads(payload)
         operation = req["operation"]
         if operation not in ("terminate", "cancel", "signal"):
             raise ValueError(f"unknown operation {operation!r}")
         domain = req["domain"]
         params = req.get("params", {})
-        targets = self._targets(req)
+        bucket = TokenBucket(float(params.get("rps", self.DEFAULT_RPS)),
+                             burst=1)
         done = 0
         errors: List[str] = []
-        for wf_id, run_id in targets:
+        for wf_id, run_id in self._targets(req):
+            while not bucket.allow():
+                _time.sleep(0.005)
             try:
                 if operation == "terminate":
                     self.frontend.terminate_workflow_execution(
@@ -70,22 +81,26 @@ class BatcherActivities:
             {"done": done, "failed": len(errors), "errors": errors[:10]}
         ).encode()
 
-    def _targets(self, req) -> List[tuple]:
+    def _targets(self, req):
+        """Stream targets page-by-page (a 100k-execution query must not
+        be materialized in one list), heartbeating once per page so a
+        dead worker is detected within the heartbeat window instead of
+        the full start-to-close timeout."""
         if req.get("executions"):
-            return [
-                (e["workflow_id"], e.get("run_id", ""))
-                for e in req["executions"]
-            ]
+            for e in req["executions"]:
+                yield (e["workflow_id"], e.get("run_id", ""))
+            return
         query = req.get("query", "")
-        out = []
         token = 0
         while True:
             recs, token = self.frontend.list_workflow_executions(
                 req["domain"], query, page_size=200, next_token=token
             )
-            out.extend((r.workflow_id, r.run_id) for r in recs)
+            activity_heartbeat(str(len(recs)).encode())
+            for r in recs:
+                yield (r.workflow_id, r.run_id)
             if not token:
-                return out
+                return
 
 
 def build_batcher_worker(frontend) -> Worker:

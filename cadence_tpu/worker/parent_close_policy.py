@@ -78,6 +78,11 @@ class ParentClosePolicyActivities:
         self.frontend = frontend
 
     def apply_parent_close_policy(self, payload: bytes) -> bytes:
+        from cadence_tpu.runtime.api import (
+            CancellationAlreadyRequestedError,
+            EntityNotExistsServiceError,
+        )
+
         children = json.loads(payload)
         applied = 0
         for child in children:
@@ -94,8 +99,13 @@ class ParentClosePolicyActivities:
                         child.get("run_id", ""),
                     )
                 applied += 1
-            except Exception:
-                continue  # child already closed
+            except (EntityNotExistsServiceError,
+                    CancellationAlreadyRequestedError):
+                continue  # child already closed/gone: policy satisfied
+            # any OTHER failure (transient store/RPC error) must fail
+            # the activity so redelivery retries — swallowing it would
+            # permanently drop the terminate/cancel (ref
+            # service/worker/parentclosepolicy processor retries)
         return str(applied).encode()
 
 

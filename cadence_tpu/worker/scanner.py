@@ -13,7 +13,9 @@ import json
 import time
 from typing import List, Optional
 
-from .sdk import Worker
+from cadence_tpu.runtime.persistence.errors import EntityNotExistsError
+
+from .sdk import ActivityError, Worker
 from .archiver import SYSTEM_DOMAIN
 
 SCANNER_WORKFLOW_TYPE = "cadence-sys-scanner-workflow"
@@ -21,14 +23,28 @@ SCANNER_WORKFLOW_ID = "cadence-scanner"
 SCANNER_TASK_LIST = "cadence-scanner-tl"
 
 
+_SCAVENGE_RETRY = {
+    "initial_interval_seconds": 2,
+    "backoff_coefficient": 2.0,
+    "maximum_interval_seconds": 60,
+    "maximum_attempts": 5,
+}
+
+
 def scanner_workflow(ctx, input: bytes):
-    """One pass of every scavenger, then sleep and continue-as-new."""
-    yield ctx.schedule_activity(
-        "scavenge_task_lists", b"", start_to_close_timeout_seconds=300,
-    )
-    yield ctx.schedule_activity(
-        "scavenge_history", b"", start_to_close_timeout_seconds=300,
-    )
+    """One pass of every scavenger, then sleep and continue-as-new.
+
+    A pass that still fails after its retry budget is LOGGED-AND-SKIPPED
+    (the next cron pass retries): one bad pass must not close the cron
+    loop Failed and silently stop scavenging until a process restart."""
+    for activity in ("scavenge_task_lists", "scavenge_history"):
+        try:
+            yield ctx.schedule_activity(
+                activity, b"", start_to_close_timeout_seconds=300,
+                retry_policy=_SCAVENGE_RETRY,
+            )
+        except ActivityError:
+            pass  # this pass is lost; the loop survives
     interval = int(input or b"60")
     yield ctx.start_timer(interval)
     yield ctx.continue_as_new(input)
@@ -153,25 +169,27 @@ class ScannerActivities:
 
         live = set()
         for shard_id in range(self.num_shards):
-            try:
-                rows = self.execution.list_concrete_executions(shard_id)
-            except Exception:
-                continue
+            # fail-SAFE: any read error aborts this scavenge pass. An
+            # incomplete live set is indistinguishable from "orphan" —
+            # e.g. a reset run whose tree id we failed to read would be
+            # classified orphan on two passes and its live history
+            # destroyed. The next cron pass retries.
+            rows = self.execution.list_concrete_executions(shard_id)
             for domain_id, wf_id, rid in rows:
                 live.add(rid)
                 try:
                     resp = self.execution.get_workflow_execution(
                         shard_id, domain_id, wf_id, rid
                     )
-                    token = (resp.snapshot or {}).get(
-                        "execution_info", {}
-                    ).get("branch_token") or b""
-                    if isinstance(token, bytes):
-                        token = token.decode()
-                    if token:
-                        live.add(BranchToken.from_json(token).tree_id)
-                except Exception:
-                    continue  # unreadable: its run id stays live
+                except EntityNotExistsError:
+                    continue  # deleted between list and read
+                token = (resp.snapshot or {}).get(
+                    "execution_info", {}
+                ).get("branch_token") or b""
+                if isinstance(token, bytes):
+                    token = token.decode()
+                if token:
+                    live.add(BranchToken.from_json(token).tree_id)
         return live
 
 

@@ -35,6 +35,19 @@ from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.runtime.api import Decision
 
 
+def _require_bytes_result(value):
+    """Workflow return values must be bytes (or None). Completing with
+    b"" for a str/dict return would silently LOSE the result — the same
+    loud-failure rule the activity worker applies."""
+    if value is None:
+        return b""
+    if isinstance(value, bytes):
+        return value
+    raise TypeError(
+        f"workflow must return bytes (or None), got {type(value).__name__}"
+    )
+
+
 class ActivityError(Exception):
     """Raised into workflow code when an activity failed/timed out."""
 
@@ -463,10 +476,23 @@ class _Driver:
         gen = self.fn(ctx, self.state.input)
         if not isinstance(gen, Generator):
             # plain function: complete immediately with its return value
+            try:
+                result = _require_bytes_result(gen)
+            except TypeError:
+                self.decisions.append(
+                    Decision(
+                        DecisionType.FailWorkflowExecution,
+                        {
+                            "reason": "workflow code raised",
+                            "details": traceback.format_exc().encode(),
+                        },
+                    )
+                )
+                return self.decisions
             self.decisions.append(
                 Decision(
                     DecisionType.CompleteWorkflowExecution,
-                    {"result": gen if isinstance(gen, bytes) else b""},
+                    {"result": result},
                 )
             )
             return self.decisions
@@ -482,7 +508,23 @@ class _Driver:
                 if blocked:
                     return self.decisions
         except StopIteration as done:
-            result = done.value if isinstance(done.value, bytes) else b""
+            try:
+                result = _require_bytes_result(done.value)
+            except TypeError:
+                # a wrong-typed return is a workflow-code bug of the
+                # same class as raising: fail the RUN loudly (silently
+                # completing with b"" would lose the result)
+                if not self.closed:
+                    self.decisions.append(
+                        Decision(
+                            DecisionType.FailWorkflowExecution,
+                            {
+                                "reason": "workflow code raised",
+                                "details": traceback.format_exc().encode(),
+                            },
+                        )
+                    )
+                return self.decisions
             if not self.closed:
                 self.decisions.append(
                     Decision(
@@ -625,6 +667,19 @@ class _Driver:
         if isinstance(cmd, _SignalExternalCmd):
             sig_idx = self.seq["s"]
             self.seq["s"] += 1
+            if sig_idx < len(st.signals_external_list) and (
+                st.signals_external_list[sig_idx]
+                != (cmd.workflow_id, cmd.signal_name)
+            ):
+                # same rule as children: the Nth yield must match the
+                # Nth recorded initiation, else a code change silently
+                # drops one signal and duplicates another
+                raise _NonDeterminismError(
+                    f"external signal #{sig_idx} in history targets "
+                    f"{st.signals_external_list[sig_idx]!r}, workflow "
+                    f"code signals "
+                    f"{(cmd.workflow_id, cmd.signal_name)!r}"
+                )
             if sig_idx >= len(st.signals_external_list):
                 self.decisions.append(
                     Decision(
@@ -646,6 +701,14 @@ class _Driver:
         if isinstance(cmd, _CancelExternalCmd):
             rc_idx = self.seq["rc"]
             self.seq["rc"] += 1
+            if rc_idx < len(st.cancels_external_list) and (
+                st.cancels_external_list[rc_idx] != cmd.workflow_id
+            ):
+                raise _NonDeterminismError(
+                    f"external cancel #{rc_idx} in history targets "
+                    f"{st.cancels_external_list[rc_idx]!r}, workflow "
+                    f"code cancels {cmd.workflow_id!r}"
+                )
             if rc_idx >= len(st.cancels_external_list):
                 self.decisions.append(
                     Decision(
@@ -1001,6 +1064,25 @@ def activity_method(fn: Callable) -> Callable:
     return fn
 
 
+# thread-local activity execution context: lets long-running activity
+# code heartbeat without threading a token through every signature
+# (reference: go client activity.RecordHeartbeat via context.Context)
+_activity_ctx = threading.local()
+
+
+def activity_heartbeat(details: bytes = b"") -> None:
+    """Record a heartbeat for the activity running on this thread.
+    No-op outside an activity (e.g. unit tests calling the fn
+    directly)."""
+    ctx = getattr(_activity_ctx, "ctx", None)
+    if ctx is None:
+        return
+    frontend, token, identity = ctx
+    frontend.record_activity_task_heartbeat(
+        token, details=details, identity=identity
+    )
+
+
 class ActivityWorker:
     def __init__(
         self, frontend, domain: str, task_list: str,
@@ -1039,7 +1121,12 @@ class ActivityWorker:
             )
             return True
         try:
-            result = fn(task.input)
+            _activity_ctx.ctx = (self.frontend, task.task_token,
+                                 self.identity)
+            try:
+                result = fn(task.input)
+            finally:
+                _activity_ctx.ctx = None
             if result is None:
                 result = b""
             if not isinstance(result, bytes):

@@ -470,3 +470,81 @@ def test_sticky_fallback_when_worker_dies(box):
     )
     assert events[-1].event_type == EventType.WorkflowExecutionCompleted
     assert events[-1].attributes["result"] == b"done:x"
+
+
+def test_non_bytes_workflow_result_fails_loudly(box):
+    """A workflow returning str/dict must NOT silently complete with
+    b"" (r5 review): the decision fails with the TypeError instead."""
+    def bad(ctx, input):
+        yield ctx.start_timer(1)
+        return "not-bytes"
+
+    w = _worker(box)
+    w.register_workflow("bad-result", bad)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-badres", "bad-result")
+        _wait_closed(box, "sdk-badres", run_id)
+        events, _ = box.frontend.get_workflow_execution_history(
+            DOMAIN, "sdk-badres", run_id
+        )
+        last = events[-1]
+        # LOUD failure: the run fails with the TypeError in details —
+        # never a silent Completed with result b""
+        assert last.event_type == EventType.WorkflowExecutionFailed, (
+            [e.event_type.name for e in events]
+        )
+        assert b"TypeError" in (last.attributes.get("details") or b"")
+    finally:
+        w.stop()
+
+
+def test_external_signal_replay_mismatch_detected(box):
+    """r5 review: the Nth signal_external yield must match the Nth
+    recorded initiation — _StateCollector + runner raise
+    _NonDeterminismError on a target mismatch instead of silently
+    dropping one signal and duplicating another."""
+    from cadence_tpu.worker.sdk import (
+        _NonDeterminismError,
+        _ReplayState,
+        replay_decide,
+    )
+
+    # build a history where the first decision recorded a signal to wfA
+    def v1(ctx, input):
+        yield ctx.signal_external(DOMAIN, "wfA", "go", b"1")
+        yield ctx.wait_signal("never")
+
+    w = _worker(box)
+    w.register_workflow("xsig", v1)
+    w.start()
+    try:
+        run_id = _start(box, "sdk-xsig", "xsig")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            events, _ = box.frontend.get_workflow_execution_history(
+                DOMAIN, "sdk-xsig", run_id
+            )
+            if any(
+                e.event_type
+                == EventType.SignalExternalWorkflowExecutionInitiated
+                for e in events
+            ):
+                break
+            time.sleep(0.05)
+    finally:
+        w.stop()
+
+    # replay that history against CHANGED code whose first yield
+    # signals wfB instead
+    def v2(ctx, input):
+        yield ctx.signal_external(DOMAIN, "wfB", "go", b"1")
+        yield ctx.wait_signal("never")
+
+    registry = w.registry if hasattr(w, "registry") else None
+    from cadence_tpu.worker.sdk import WorkflowRegistry
+
+    reg = WorkflowRegistry()
+    reg.register_workflow("xsig", v2)
+    with pytest.raises(_NonDeterminismError):
+        replay_decide(reg, events)
