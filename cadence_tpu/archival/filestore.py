@@ -98,7 +98,9 @@ class FilestoreHistoryArchiver(HistoryArchiver):
             [HistoryEvent.from_dict(d) for d in b]
             for b in payload["batches"]
         ]
-        if page_size:
+        if page_size > 0:  # a negative size would return an empty page
+            # with an unchanged token — the infinite-pagination bug
+            # class fixed in the visibility paginators (r4)
             page = batches[next_token : next_token + page_size]
             token = next_token + len(page)
             return page, (token if token < len(batches) else 0)
@@ -146,15 +148,24 @@ class FilestoreVisibilityArchiver(VisibilityArchiver):
     ) -> Tuple[List[VisibilityRecord], int]:
         self.validate_uri(uri)
         d = self._dir(uri, domain_id)
+        # archived visibility files are immutable (one atomic write per
+        # closed run), so parse each file ONCE per archiver instance —
+        # without this a paged scan re-reads every file per page
+        # (O(N^2) opens across a listing)
+        cache = getattr(self, "_parsed", None)
+        if cache is None:
+            cache = self._parsed = {}
+        parsed = cache.setdefault(d, {})
         records: List[VisibilityRecord] = []
         if os.path.isdir(d):
             for name in sorted(os.listdir(d)):
                 if not name.endswith(".json"):
                     continue
-                with open(os.path.join(d, name)) as f:
-                    p = json.load(f)
-                records.append(
-                    VisibilityRecord(
+                rec = parsed.get(name)
+                if rec is None:
+                    with open(os.path.join(d, name)) as f:
+                        p = json.load(f)
+                    rec = parsed[name] = VisibilityRecord(
                         domain_id=p["domain_id"],
                         workflow_id=p["workflow_id"],
                         run_id=p["run_id"],
@@ -166,7 +177,7 @@ class FilestoreVisibilityArchiver(VisibilityArchiver):
                         history_length=p.get("history_length", 0),
                         search_attributes=p.get("search_attributes", {}),
                     )
-                )
+                records.append(rec)
         if page_size <= 0:
             page_size = 100  # see AdvancedVisibilityStore: a zero page
             # would return the same token forever

@@ -202,7 +202,14 @@ class _Parser:
                 if nxt[0] != "comma":
                     raise QueryError("expected , or ) in IN list")
             vals = set(values)
-            return lambda r: get(r) in vals
+
+            def in_pred(r: VisibilityRecord) -> bool:
+                try:
+                    return get(r) in vals
+                except TypeError:
+                    return False  # unhashable attr value: no match
+
+            return in_pred
         if tok[0] != "op":
             raise QueryError(f"expected operator after {field!r}")
         op = tok[1]
@@ -256,16 +263,21 @@ class VisibilityQuery:
         out = [r for r in records if self.predicate(r)]
         if self.order_field:
             get = _field_getter(self.order_field)
-            # type-stable key: mixed-typed search-attribute values must
-            # not blow up list.sort with a str-vs-int comparison
-            out.sort(
-                key=lambda r: (
-                    get(r) is None,
-                    type(get(r)).__name__,
-                    get(r) if get(r) is not None else 0,
-                ),
-                reverse=self.order_desc,
-            )
+
+            def key(r):
+                # type-stable key: mixed-typed search-attribute values
+                # must not blow up list.sort with a str-vs-int
+                # comparison — but all NUMERIC types (bool/int/float)
+                # collapse into one group so 1 sorts before 2.5, not
+                # after it by type name
+                v = get(r)
+                if v is None:
+                    return (True, "", 0)
+                if isinstance(v, (bool, int, float)):
+                    return (False, "\x00number", float(v))
+                return (False, type(v).__name__, v)
+
+            out.sort(key=key, reverse=self.order_desc)
         return out
 
 

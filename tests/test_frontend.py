@@ -517,3 +517,50 @@ def test_get_cluster_info(fb):
     info = fb.frontend.get_cluster_info()
     assert info["server"] == "cadence-tpu"
     assert "cli" in info["supported_client_versions"]
+
+
+def test_visibility_query_mixed_numeric_sort_and_in_guard():
+    """r5 review: ORDER BY must sort bool/int/float by magnitude (not
+    by type name), and IN must skip unhashable attribute values instead
+    of crashing the whole list call."""
+    from cadence_tpu.runtime.persistence.records import VisibilityRecord
+    from cadence_tpu.visibility.query import compile_query
+
+    def rec(i, attr):
+        return VisibilityRecord(
+            domain_id="d", workflow_id=f"w{i}", run_id=f"r{i}",
+            workflow_type="t", start_time=i, execution_time=i,
+            close_time=0, close_status=0, history_length=1,
+            search_attributes={"CustomDoubleField": attr},
+        )
+
+    rows = [rec(0, 2.5), rec(1, 1), rec(2, True), rec(3, 10)]
+    q = compile_query("ORDER BY CustomDoubleField ASC")
+    got = [r.search_attributes["CustomDoubleField"] for r in q.apply(rows)]
+    assert got == [True, 1, 2.5, 10], got  # magnitude order: 1,1,2.5,10
+
+    # IN over an unhashable (list-valued) attribute: skip, don't crash
+    rows2 = [rec(0, [1, 2]), rec(1, 5)]
+    q2 = compile_query("CustomDoubleField IN (5, 7)")
+    got2 = q2.apply(rows2)
+    assert [r.workflow_id for r in got2] == ["w1"]
+
+
+def test_filestore_history_get_negative_page_size(tmp_path):
+    """r5 review: a negative page_size must not yield an empty page
+    with an unchanged token (infinite pagination)."""
+    from cadence_tpu.archival.filestore import FilestoreHistoryArchiver
+    from cadence_tpu.archival.interfaces import ArchiveHistoryRequest, URI
+    from cadence_tpu.core.events import HistoryEvent
+    from cadence_tpu.core.enums import EventType
+
+    arch = FilestoreHistoryArchiver()
+    uri = URI.parse(f"file://{tmp_path}")
+    ev = HistoryEvent(event_id=1, event_type=EventType.WorkflowExecutionStarted,
+                      timestamp=1, version=0, attributes={})
+    arch.archive(uri, ArchiveHistoryRequest(
+        domain_id="d", domain_name="d", workflow_id="w", run_id="r",
+        branch_token=b"", next_event_id=2, close_failover_version=0,
+    ), [[ev]])
+    batches, token = arch.get(uri, "d", "w", "r", page_size=-1)
+    assert batches and token == 0  # falls back to the unpaged read
