@@ -111,6 +111,19 @@ class AdminHandler:
         n = engine.refresh_workflow_tasks(domain_id, workflow_id, run_id)
         return {"tasks_generated": n}
 
+    def admin_describe_workflow_execution(
+        self, domain_name: str, workflow_id: str, run_id: str = ""
+    ) -> Dict[str, Any]:
+        """RPC-reachable name for the admin variant: the frontend
+        endpoint dispatches by name across [frontend, admin] targets
+        with first-match, so the shared name
+        ``describe_workflow_execution`` always resolves to the PUBLIC
+        WorkflowHandler — this alias keeps the admin introspection
+        surface reachable over the wire."""
+        return self.describe_workflow_execution(
+            domain_name, workflow_id, run_id
+        )
+
     def describe_workflow_execution(
         self, domain_name: str, workflow_id: str, run_id: str = ""
     ) -> Dict[str, Any]:

@@ -45,20 +45,27 @@ class ArchivalStatus:
 
 
 def _next_archival_state(
-    status: int, uri: str, req_status: Optional[int], req_uri: str
+    status: int, uri: str, req_status: Optional[int], req_uri: str,
+    kind: str = "history",
 ) -> tuple:
     """(status', uri') — reference archivalConfigStateMachine.getNextState:
     the URI is write-once; enabling requires a URI; disable keeps it."""
     if req_uri and uri and req_uri != uri:
         raise BadRequestError("archival URI is immutable once set")
     if req_uri and not uri:
-        # validate at SET time — the URI is write-once, so a typo
-        # accepted here permanently breaks the domain's archival
+        # validate at SET time against the archiver registry for the
+        # RIGHT kind — the URI is write-once, so accepting a history
+        # scheme as a visibility URI (or a typo) permanently breaks
+        # the domain's archival
         from cadence_tpu.archival import ArchiverProvider, URI
 
         try:
             parsed = URI.parse(req_uri)
-            ArchiverProvider.default().get_history_archiver(parsed.scheme)
+            provider = ArchiverProvider.default()
+            if kind == "visibility":
+                provider.get_visibility_archiver(parsed.scheme)
+            else:
+                provider.get_history_archiver(parsed.scheme)
         except Exception as e:
             raise BadRequestError(f"invalid archival URI {req_uri!r}: {e}")
     new_uri = uri or req_uri
@@ -154,7 +161,7 @@ class DomainHandler:
         )
         v_status, v_uri = _next_archival_state(
             ArchivalStatus.NEVER_ENABLED, "", visibility_archival_status,
-            visibility_archival_uri,
+            visibility_archival_uri, kind="visibility",
         )
         if failover_version is None:
             failover_version = (
@@ -287,6 +294,7 @@ class DomainHandler:
             rec.config.visibility_archival_status,
             rec.config.visibility_archival_uri,
             visibility_archival_status, visibility_archival_uri,
+            kind="visibility",
         )
 
         if add_bad_binary:

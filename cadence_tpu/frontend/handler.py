@@ -165,6 +165,12 @@ class WorkflowHandler:
     ) -> str:
         self._check(request.start.domain, **headers)
         self._check_id(request.start.workflow_id, "workflowId")
+        # the embedded START must pass the same frontend limits as
+        # start_workflow_execution — without these, oversized inputs /
+        # overlong identifiers bypass the limits entirely on this path
+        self._check_id(request.start.workflow_type, "workflowType")
+        self._check_id(request.start.task_list, "taskList")
+        self._check_blob(request.start.input, "workflow input")
         self._check_id(request.signal_name, "signalName")
         self._check_blob(request.signal_input, "signal input")
         self._check_cron(request.start.cron_schedule)

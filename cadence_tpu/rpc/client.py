@@ -33,7 +33,17 @@ class _Method:
             cls_name, _, msg = details.partition(": ")
             exc_type = ERROR_TYPES.get(cls_name)
             if exc_type is not None:
-                raise _build(exc_type, msg) from None
+                exc = _build(exc_type, msg)
+                # restore the structured attributes the server attached
+                # (e.run_id, e.shard_id, ...) — without them the rebuilt
+                # instance is a bare-message shell
+                for key, value in (e.trailing_metadata() or ()):
+                    if key == "error-attrs-bin":
+                        try:
+                            exc.__dict__.update(codec.loads(value))
+                        except Exception:
+                            pass
+                raise exc from None
             raise
 
 

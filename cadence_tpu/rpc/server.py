@@ -78,6 +78,21 @@ class _Generic(grpc.GenericRpcHandler):
             except Exception as e:
                 cls = type(e).__name__
                 code = ERROR_CODES.get(cls, grpc.StatusCode.INTERNAL)
+                # ship the error's structured attributes so the client
+                # rebuilds a faithful instance (e.run_id on
+                # AlreadyStarted, e.shard_id/.owner on
+                # ShardOwnershipLost), not a bare-message shell
+                attrs = {
+                    k: v for k, v in vars(e).items()
+                    if isinstance(v, (str, int, float, bool, bytes))
+                }
+                if attrs:
+                    try:
+                        context.set_trailing_metadata(
+                            (("error-attrs-bin", codec.dumps(attrs)),)
+                        )
+                    except Exception:
+                        pass  # diagnostics only; the error still flows
                 context.abort(code, f"{cls}: {e}")
 
         return grpc.unary_unary_rpc_method_handler(

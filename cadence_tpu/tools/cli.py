@@ -397,7 +397,9 @@ def cmd_admin(args) -> None:
         fe.close_shard(args.shard_id)
         _print({"closed": args.shard_id})
     elif args.admin_cmd == "describe-workflow":
-        _print(fe.describe_workflow_execution(
+        # distinct RPC name: the public describe_workflow_execution
+        # shadows the admin variant in by-name dispatch
+        _print(fe.admin_describe_workflow_execution(
             args.domain, args.workflow_id, args.run_id or ""
         ))
     elif args.admin_cmd == "refresh-tasks":

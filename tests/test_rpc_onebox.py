@@ -114,3 +114,32 @@ def test_admin_over_grpc(remote):
     _, fe = remote
     desc = fe.describe_history_host()
     assert desc["shard_count"] == 2
+
+
+def test_wire_errors_carry_structured_attributes(remote):
+    """r5 review: a rebuilt wire error must not be a bare-message shell
+    — WorkflowExecutionAlreadyStarted carries .run_id over RPC exactly
+    as it does in-process (callers attach to the running execution)."""
+    from cadence_tpu.runtime.api import (
+        WorkflowExecutionAlreadyStartedServiceError,
+    )
+
+    box, fe = remote
+    fe.register_domain("attr-dom")
+    run_id = fe.start_workflow_execution(StartWorkflowRequest(
+        domain="attr-dom", workflow_id="attr-wf", workflow_type="t",
+        task_list="attr-tl",
+        execution_start_to_close_timeout_seconds=60,
+        request_id="req-1",
+    ))
+    with pytest.raises(
+        WorkflowExecutionAlreadyStartedServiceError
+    ) as err:
+        fe.start_workflow_execution(StartWorkflowRequest(
+            domain="attr-dom", workflow_id="attr-wf", workflow_type="t",
+            task_list="attr-tl",
+            execution_start_to_close_timeout_seconds=60,
+            request_id="req-2",
+        ))
+    assert err.value.run_id == run_id
+    assert err.value.start_request_id == "req-1"
