@@ -126,6 +126,14 @@ class ClusterMetadata:
     def cluster_name_for_failover_version(self, version: int) -> str:
         if version == EMPTY_VERSION:
             return self._current
+        if version < 0:
+            # Python's % yields a non-negative residue, so a corrupt
+            # negative version would silently map onto a REAL cluster
+            # (the Go reference's negative modulo fails the lookup);
+            # surface the corruption instead of mis-routing it
+            raise ValueError(
+                f"invalid negative failover version {version}"
+            )
         initial = version % self._increment
         name = self._version_to_cluster.get(initial)
         if name is None:

@@ -108,7 +108,12 @@ class MatchingEngine:
             if mgr is not None:
                 return mgr
             forwarder = Forwarder(tl_id, self)
+            from cadence_tpu.utils.quotas import TokenBucket
+
             matcher = TaskMatcher(
+                # matching.rps dynamic config, read at manager creation
+                # (reference taskListManager rate limiter)
+                rate_limiter=TokenBucket(self._tasklist_rps()),
                 forward_offer=(
                     forwarder.forward_offer if forwarder.enabled else None
                 ),
@@ -202,6 +207,11 @@ class MatchingEngine:
                 return None, None
             task: Optional[InternalTask] = mgr.get_task(remaining)
             if task is None:
+                if mgr.matcher.is_shutdown:
+                    # unload/shutdown raced this long poll: get_task now
+                    # returns instantly — re-looping would busy-spin at
+                    # full speed for the rest of the poll deadline
+                    return None, None
                 continue  # interrupted or forwarded miss; re-check deadline
             info = task.info
             if task.query is not None:
@@ -432,18 +442,24 @@ class MatchingEngine:
             mgr.matcher.interrupt_all()
 
     def unload_idle_task_lists(self) -> int:
-        """GC managers idle past their TTL (taskListManager idle unload)."""
-        removed = 0
+        """GC managers idle past their TTL (taskListManager idle unload).
+
+        stop() joins the writer thread and does store I/O — it runs
+        OUTSIDE the engine lock, or one stalled task list turns a
+        periodic sweep into an engine-wide matching outage."""
+        stopping = []
         with self._lock:
             for key, mgr in list(self._managers.items()):
                 if mgr.idle_since_s() > mgr.idle_ttl_s:
-                    mgr.stop()
                     del self._managers[key]
-                    removed += 1
-        return removed
+                    stopping.append(mgr)
+        for mgr in stopping:
+            mgr.stop()
+        return len(stopping)
 
     def shutdown(self) -> None:
         with self._lock:
-            for mgr in self._managers.values():
-                mgr.stop()
+            managers = list(self._managers.values())
             self._managers.clear()
+        for mgr in managers:
+            mgr.stop()

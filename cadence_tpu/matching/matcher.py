@@ -101,13 +101,25 @@ class TaskMatcher:
 
     def poll(self, timeout: float):
         """Wait up to ``timeout`` seconds for a task; None on timeout or
-        shutdown. Reference matcher.Poll."""
+        shutdown. Reference matcher.Poll.
+
+        With a forwarder, the budget is SPLIT: half parked on the local
+        slot list, the remainder parked on the parent partition — a
+        zero-budget forward could never match (the parent-side slot
+        would be created and cancelled inside one lock hold, invisible
+        to any producer). The reference selects on both channels
+        simultaneously; the sequential split is the single-lock
+        equivalent and bounds added dispatch latency at timeout/2."""
+        deadline = time.monotonic() + timeout
+        local_budget = (
+            timeout if self._forward_poll is None else timeout / 2
+        )
         slot = _PollSlot(self._lock)
         with self._lock:
             self._slots.append(slot)
-            deadline = time.monotonic() + timeout
+            local_deadline = time.monotonic() + local_budget
             while not slot.done and not self._shutdown.is_set():
-                remaining = deadline - time.monotonic()
+                remaining = local_deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 slot.cv.wait(remaining)
@@ -120,10 +132,12 @@ class TaskMatcher:
                 self._slots.remove(slot)
             except ValueError:
                 pass  # a producer already popped it mid-handoff scan
-        # local miss: one forwarded attempt before giving up (matcher
-        # polls the parent partition when the local backlog is dry)
+        # local miss: park the remaining budget on the parent partition
+        # (matcher polls the parent when the local backlog is dry)
         if self._forward_poll is not None and not self._shutdown.is_set():
-            return self._forward_poll(0.0)
+            return self._forward_poll(
+                max(0.0, deadline - time.monotonic())
+            )
         return None
 
     def poller_count(self) -> int:
@@ -137,6 +151,10 @@ class TaskMatcher:
                 slot = self._slots.popleft()
                 if not slot.cancelled:
                     slot.fulfill(None)
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown.is_set()
 
     def shutdown(self) -> None:
         self._shutdown.set()

@@ -423,7 +423,16 @@ class TaskListManager:
         # store rows + persists the ack level (reference taskGC.go)
         self._ack.complete(task_id)
         ack = self._ack.update_ack_level()
-        self._gc.maybe_run(ack)
+        try:
+            self._gc.maybe_run(ack)
+        except Exception:
+            # GC is best-effort cleanup on the task-FINISH path, which
+            # runs AFTER record_*_task_started succeeded — letting a
+            # transient store error unwind here would destroy the poll
+            # response for an already-started task (the worker never
+            # sees it; the workflow stalls to its task timeout). Rows
+            # stay until the next due GC pass.
+            self._log.exception("task GC failed; deferring cleanup")
 
     # -- lifecycle ------------------------------------------------------
 
@@ -448,5 +457,10 @@ class TaskListManager:
         self._backlog_signal.set()
         self._writer.stop()
         self.matcher.shutdown()
-        # final GC pass so a clean shutdown leaves no acked rows behind
-        self._gc.run_now(self._ack.update_ack_level())
+        # final GC pass so a clean shutdown leaves no acked rows behind;
+        # best-effort — stop() runs under the engine lock during idle
+        # unload, and a store error must not abort that sweep
+        try:
+            self._gc.run_now(self._ack.update_ack_level())
+        except Exception:
+            self._log.exception("final task GC failed on stop")
