@@ -82,6 +82,51 @@ def read_due_timers(
     return after
 
 
+_ATTEMPT_BACKOFF_S = (0.05, 0.2)  # between in-line attempts
+_EXHAUSTED_RETRY_DELAY_S = 5.0    # park interval after the budget
+
+
+def run_task_attempts(
+    process, task, key, ack, stopped, log, scope, name,
+    retry_count: int = _TASK_RETRY_COUNT,
+) -> bool:
+    """Shared queue-task attempt loop (active transfer/timer + standby
+    twins — ONE copy, they had drifted). Returns True when the caller
+    should run its completion step (success, or the task is permanently
+    stale); False when the task was parked or the processor is
+    stopping.
+
+    Transient failures back off between attempts, and an EXHAUSTED
+    budget parks the task for a deferred retry instead of acking it
+    away — a sub-second dependency outage must not permanently drop a
+    task (the reference never acks an errored task). A genuinely
+    poisoned task retries at the defer cadence until an operator
+    removes it (admin remove-task)."""
+    for attempt in range(retry_count):
+        if stopped.is_set():
+            return False
+        try:
+            process(task)
+            return True
+        except DeferTask:
+            defer_task(ack, key)
+            return False
+        except EntityNotExistsServiceError:
+            return True  # stale task: workflow/decision moved on
+        except Exception:
+            scope.inc("task_errors")
+            if attempt < retry_count - 1:
+                stopped.wait(_ATTEMPT_BACKOFF_S[
+                    min(attempt, len(_ATTEMPT_BACKOFF_S) - 1)
+                ])
+    log.exception(
+        f"queue {name} task {key} failed {retry_count} attempts; "
+        f"parked for retry in {_EXHAUSTED_RETRY_DELAY_S}s"
+    )
+    defer_task(ack, key, _EXHAUSTED_RETRY_DELAY_S)
+    return False
+
+
 @contextlib.contextmanager
 def timed_task(metrics: Scope, task):
     """Standard queue-task triple, tagged by task type: requests counter
@@ -195,24 +240,12 @@ class QueueProcessorBase:
 
     def _run_task(self, task, key) -> None:
         with timed_task(self._metrics, task) as scope:
-            for attempt in range(_TASK_RETRY_COUNT):
-                if self._stopped.is_set():
-                    return
-                try:
-                    self._process_task(task)
-                    break
-                except DeferTask:
-                    defer_task(self.ack, key)
-                    return
-                except EntityNotExistsServiceError:
-                    break  # stale task: workflow/decision moved on
-                except Exception:
-                    scope.inc("task_errors")
-                    if attempt == _TASK_RETRY_COUNT - 1:
-                        self._log.exception(
-                            f"queue {self.name} task {key} dropped after "
-                            f"{_TASK_RETRY_COUNT} attempts"
-                        )
+            finished = run_task_attempts(
+                self._process_task, task, key, self.ack, self._stopped,
+                self._log, scope, self.name,
+            )
+        if not finished:
+            return  # parked (deferred / exhausted-retry) or stopping
         try:
             self._complete_task(task)
         except Exception:

@@ -48,6 +48,7 @@ from .base import (
     QueueProcessorBase,
     ResumeCursor,
     read_due_timers,
+    run_task_attempts,
     timed_task,
 )
 from .timer_gate import RemoteTimerGate
@@ -148,6 +149,11 @@ class _StandbyAllocator:
         # locally-active means a failover whose held span must hand
         # over to the active processor
         self._stood_by: set = set()
+        # newest failover version observed per domain: a worker that
+        # read the record BEFORE a failover must not re-arm _stood_by
+        # AFTER another worker consumed the handover (the stale re-add
+        # would rewind the active cursor a second time)
+        self._seen_version: dict = {}
         self._claim_lock = threading.Lock()
 
     def classify(self, domain_id: str) -> str:
@@ -161,12 +167,17 @@ class _StandbyAllocator:
         if not rec.is_global:
             return "other"
         active = rec.replication_config.active_cluster_name
-        if active == self.cluster:
-            self._stood_by.add(domain_id)
-            return "owned"
-        if domain_id in self._stood_by and active == self.local_cluster:
-            return "handover"
-        return "other"
+        with self._claim_lock:
+            fv = rec.failover_version
+            if fv < self._seen_version.get(domain_id, -1):
+                return "other"  # stale record from before a failover
+            self._seen_version[domain_id] = fv
+            if active == self.cluster:
+                self._stood_by.add(domain_id)
+                return "owned"
+            if domain_id in self._stood_by and active == self.local_cluster:
+                return "handover"
+            return "other"
 
     def claim_handover(self, domain_id: str) -> bool:
         """Compare-and-consume: exactly ONE concurrent caller wins the
@@ -452,6 +463,9 @@ class TimerQueueStandbyProcessor:
         self._stopped.set()
         self.gate.update(0)
         self._pool.shutdown(wait=False)
+        # detach from the shard or this dead processor stays reachable
+        # (and notified) through the remote-time listener list forever
+        self.shard.remove_remote_time_listener(self._on_remote_time)
 
     def drain(self, timeout_s: float = 5.0) -> bool:
         import time
@@ -510,27 +524,13 @@ class TimerQueueStandbyProcessor:
 
     def _run_task(self, task: TimerTask, key) -> None:
         with timed_task(self._metrics, task) as scope:
-            self._run_task_inner(task, key, scope)
-
-    def _run_task_inner(self, task: TimerTask, key, scope) -> None:
-        for attempt in range(self._TASK_RETRY_COUNT):
-            if self._stopped.is_set():
-                return
-            try:
-                self._process(task)
-                break
-            except DeferTask:
-                defer_task(self.ack, key)
-                return
-            except EntityNotExistsServiceError:
-                break
-            except Exception:
-                scope.inc("task_errors")
-                if attempt == self._TASK_RETRY_COUNT - 1:
-                    self._log.exception(
-                        f"standby timer task {key} dropped after "
-                        f"{self._TASK_RETRY_COUNT} attempts"
-                    )
+            finished = run_task_attempts(
+                self._process, task, key, self.ack, self._stopped,
+                self._log, scope, self.name,
+                retry_count=self._TASK_RETRY_COUNT,
+            )
+        if not finished:
+            return  # parked (deferred / exhausted-retry) or stopping
         # no task-row deletion on standby; cursor-only
         self.ack.complete(key)
 

@@ -26,7 +26,12 @@ from cadence_tpu.utils.metrics import NOOP
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, TaskAllocator, defer_task
-from .base import ResumeCursor, read_due_timers, timed_task
+from .base import (
+    ResumeCursor,
+    read_due_timers,
+    run_task_attempts,
+    timed_task,
+)
 from .timer_gate import LocalTimerGate
 
 _TIMEOUT_REASON = "cadenceInternal:Timeout"
@@ -153,24 +158,13 @@ class TimerQueueProcessor:
 
     def _run_task(self, task: TimerTask, key) -> None:
         with timed_task(self._metrics, task) as scope:
-            for attempt in range(self._TASK_RETRY_COUNT):
-                if self._stopped.is_set():
-                    return
-                try:
-                    self._process(task)
-                    break
-                except DeferTask:
-                    defer_task(self.ack, key)
-                    return
-                except EntityNotExistsServiceError:
-                    break  # workflow gone / state moved on: stale timer
-                except Exception:
-                    scope.inc("task_errors")
-                    if attempt == self._TASK_RETRY_COUNT - 1:
-                        self._log.exception(
-                            f"timer task {key} ({task.task_type}) dropped "
-                            f"after {self._TASK_RETRY_COUNT} attempts"
-                        )
+            finished = run_task_attempts(
+                self._process, task, key, self.ack, self._stopped,
+                self._log, scope, self.name,
+                retry_count=self._TASK_RETRY_COUNT,
+            )
+        if not finished:
+            return  # parked (deferred / exhausted-retry) or stopping
         if not self.has_standby:   # with standby planes, QueueGC deletes
             try:
                 self.shard.persistence.execution.complete_timer_task(

@@ -393,9 +393,16 @@ class NDCHistoryReplicator:
         new_vh = VersionHistory(
             branch_token=forked.to_json().encode(), items=items
         )
-        _, new_index = local.add_version_history(new_vh)
-        # add_version_history may have flipped current; restore — the
-        # conflict resolver owns that decision
+        prior_current = local.current_index
+        changed, new_index = local.add_version_history(new_vh)
+        if changed:
+            # add_version_history flips current when the fork's last
+            # version is the max; the CONFLICT RESOLVER owns that
+            # decision — without this restore, _apply_for_existing
+            # would see branch_index == current_index and apply the
+            # incoming batch onto the old branch's un-rebuilt state
+            # (append-at-end keeps prior indices stable)
+            local.current_index = prior_current
         return new_index
 
     # -- apply variants ------------------------------------------------

@@ -47,6 +47,7 @@ class ReplicatorQueueProcessor:
             service="history_replication", shard=str(shard.shard_id)
         )
         self._max_served = 0
+        self._completed_through = 0  # highest min-ack already swept
 
     # -- hydration ----------------------------------------------------
 
@@ -189,6 +190,12 @@ class ReplicatorQueueProcessor:
                 return
             self._cluster_ack[cluster] = level
             min_ack = min(self._cluster_ack.values())
+            # skip the store scan when the MIN cursor hasn't moved —
+            # every fetch calls ack(), and an unconditional scan from 0
+            # is a wasted queue read per poll per cluster per shard
+            if min_ack <= self._completed_through:
+                return
+            self._completed_through = min_ack
         if min_ack <= 0:
             return
         # scan the whole completed prefix, not just one batch

@@ -175,6 +175,14 @@ class ShardContext:
     def add_remote_time_listener(self, fn) -> None:
         self._remote_time_listeners.append(fn)
 
+    def remove_remote_time_listener(self, fn) -> None:
+        """Detach a listener (standby processor stop): a dead processor
+        must not stay reachable from the shard's listener list."""
+        try:
+            self._remote_time_listeners.remove(fn)
+        except ValueError:
+            pass
+
     def get_replication_ack_level(self) -> int:
         with self._lock:
             return self._info.replication_ack_level
