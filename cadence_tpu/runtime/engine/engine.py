@@ -396,7 +396,16 @@ class HistoryEngine:
             )
             run_id = cur.run_id
             if cur.state != int(WorkflowState.Completed):
-                self.signal_workflow_execution(
+                # delegate through the RAW methods: the instance's are
+                # metric-wrapped (instrument_methods), and going through
+                # them would phantom-count every SignalWithStart as a
+                # start/signal RPC too (the reference instruments at
+                # the handler boundary only)
+                raw_signal = getattr(
+                    self.signal_workflow_execution, "__wrapped__",
+                    self.signal_workflow_execution,
+                )
+                raw_signal(
                     SignalRequest(
                         domain=start.domain,
                         workflow_id=start.workflow_id,
@@ -409,7 +418,11 @@ class HistoryEngine:
                 return run_id
         except (EntityNotExistsServiceError, EntityNotExistsError):
             pass
-        return self.start_workflow_execution(
+        raw_start = getattr(
+            self.start_workflow_execution, "__wrapped__",
+            self.start_workflow_execution,
+        )
+        return raw_start(
             start,
             domain_id=domain.info.id,
             signal_name=request.signal_name,

@@ -121,7 +121,11 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/debug/pprof/profile":
                 seconds = float(q.get("seconds", ["5"])[0])
                 hz = float(q.get("hz", ["100"])[0])
-                self._reply(200, sample_cpu(min(seconds, 120.0), hz))
+                # clamp like seconds: an absurd hz would busy-spin a
+                # core walking every thread's stack for the whole window
+                self._reply(200, sample_cpu(
+                    min(seconds, 120.0), min(hz, 1000.0)
+                ))
             elif path == "/debug/pprof/heap":
                 self._reply(200, heap_top(int(q.get("topn", ["30"])[0])))
             else:

@@ -80,6 +80,17 @@ class KeyedSequentialProcessor:
                         self._log.exception("on_error callback failed")
                 else:
                     self._log.exception(f"task for key {key!r} raised")
+            except BaseException:
+                # SystemExit/KeyboardInterrupt reaching a worker would
+                # otherwise leave the key claimed with a drainer-less
+                # queue: that key's tasks silently stop applying and
+                # flush() never returns. Drop the key's queue (its
+                # pending count included), then let the executor
+                # surface it.
+                with self._lock:
+                    dropped = self._queues.pop(key, None)
+                    self._pending -= len(dropped) if dropped else 0
+                raise
             finally:
                 with self._lock:
                     self._pending -= 1
