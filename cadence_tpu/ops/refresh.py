@@ -323,26 +323,24 @@ def hydrate_tasks(
             event_id=sid,
             version=ver,
         ))
-    for init in sorted(int(x) for x in r.child_transfer[b] if x != -1):
-        slot = next(
-            s for s, x in enumerate(r.child_transfer[b]) if int(x) == init
+    def _by_initiated(row):
+        """(initiated_id, slot) pairs in initiated order — one linear
+        pass instead of a next()-rescan per emitted task."""
+        return sorted(
+            (int(x), s) for s, x in enumerate(row) if x != -1
         )
+
+    for init, slot in _by_initiated(r.child_transfer[b]):
         transfer.append(T.start_child_transfer_task(
             side.child_domains.get(slot, ""),
             side.child_workflow_ids.get(slot, ""), init,
         ))
-    for init in sorted(int(x) for x in r.cancel_transfer[b] if x != -1):
-        slot = next(
-            s for s, x in enumerate(r.cancel_transfer[b]) if int(x) == init
-        )
+    for init, slot in _by_initiated(r.cancel_transfer[b]):
         tgt = side.cancel_targets.get(slot) or ("", "", "", False)
         transfer.append(T.cancel_external_transfer_task(
             tgt[0] or domain_id, tgt[1], tgt[2], tgt[3], init,
         ))
-    for init in sorted(int(x) for x in r.signal_transfer[b] if x != -1):
-        slot = next(
-            s for s, x in enumerate(r.signal_transfer[b]) if int(x) == init
-        )
+    for init, slot in _by_initiated(r.signal_transfer[b]):
         tgt = side.signal_targets.get(slot) or ("", "", "", False)
         transfer.append(T.signal_external_transfer_task(
             tgt[0] or domain_id, tgt[1], tgt[2], tgt[3], init,

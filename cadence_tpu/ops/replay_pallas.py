@@ -621,19 +621,17 @@ def narrow_events_teb(events_teb, force_wide=()):
     phys, P = _phys_map(wide_cols)
     T, _, B = ev.shape
     out = np.empty((T, P, B), np.int16)
-    # stage one column at a time: a whole-tensor int64 copy would be a
-    # transient 2x the event tensor (gigabytes at serving chunk sizes)
+    # no widening staging needed: the wide lo-half is exactly the
+    # two's-complement int16 truncation, and the affine subtraction
+    # cannot overflow int32 (|col - base| <= ~32.5k by construction)
     for c in range(S.EV_N):
         p = phys[c]
-        col = ev[:, c, :].astype(np.int64)
+        col = ev[:, c, :]
         if c in wide_cols:
-            lo16 = col & 0xFFFF
-            out[:, p, :] = np.where(
-                lo16 >= 32768, lo16 - 65536, lo16
-            ).astype(np.int16)
-            out[:, p + 1, :] = (ev[:, c, :] >> 16).astype(np.int16)
+            out[:, p, :] = col.astype(np.int16)          # low 16 bits
+            out[:, p + 1, :] = (col >> 16).astype(np.int16)
         else:
-            out[:, p, :] = (col - base64[c]).astype(np.int16)
+            out[:, p, :] = (col - np.int32(base64[c])).astype(np.int16)
     return out, base64.astype(np.int32), wide_cols
 
 
