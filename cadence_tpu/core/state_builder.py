@@ -286,12 +286,14 @@ class StateBuilder:
                 rci.target_run_id = a.get("run_id", "")
                 rci.target_child_workflow_only = a.get(
                     "child_workflow_only", False)
+                # task fields come FROM the stored info so the two can
+                # never silently diverge
                 self.transfer_tasks.append(
                     T.cancel_external_transfer_task(
-                        self.domain_resolver(a.get("domain", "")),
-                        a.get("workflow_id", ""),
-                        a.get("run_id", ""),
-                        a.get("child_workflow_only", False),
+                        rci.target_domain_id,
+                        rci.target_workflow_id,
+                        rci.target_run_id,
+                        rci.target_child_workflow_only,
                         rci.initiated_id,
                     )
                 )
@@ -315,10 +317,10 @@ class StateBuilder:
                     "child_workflow_only", False)
                 self.transfer_tasks.append(
                     T.signal_external_transfer_task(
-                        self.domain_resolver(a.get("domain", "")),
-                        a.get("workflow_id", ""),
-                        a.get("run_id", ""),
-                        a.get("child_workflow_only", False),
+                        si.target_domain_id,
+                        si.target_workflow_id,
+                        si.target_run_id,
+                        si.target_child_workflow_only,
                         si.initiated_id,
                     )
                 )

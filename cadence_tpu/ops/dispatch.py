@@ -51,8 +51,12 @@ class DeviceDispatcher:
         depth: int = 2,
         kernel: str = "auto",
         narrow: bool = True,
+        domain_resolver=None,
     ) -> None:
         self.caps = caps or S.Capacities()
+        # threaded into pack_workflow: side-table target domains must
+        # be RESOLVED ids, matching the host oracle (StateBuilder)
+        self.domain_resolver = domain_resolver
         # int16 narrow event stream (replay_pallas.narrow_events_teb):
         # halves both the H2D transfer and the HBM stream the kernel is
         # bound by; falls back per batch when a gating column is wide.
@@ -118,7 +122,10 @@ class DeviceDispatcher:
                 return
             batch_id, histories = item
             try:
-                packed = pack_histories(histories, caps=self.caps)
+                packed = pack_histories(
+                    histories, caps=self.caps,
+                    domain_resolver=self.domain_resolver,
+                )
                 narrow_meta = None
                 if use_pallas:
                     teb = packed.teb()

@@ -201,16 +201,25 @@ def pack_workflow(
     run_id: str = "",
     request_id: str = "",
     epoch_s: Optional[int] = None,
+    domain_resolver=None,
 ) -> Tuple[np.ndarray, WorkflowSideTable]:
     """Pack one workflow's history (a sequence of transaction batches) into
     an [n_events, EV_N] int32 array + its side table.
 
     ``epoch_s``: shared batch epoch (defaults to this workflow's first
-    event); all timestamps become rel = abs_s - epoch_s + 1."""
+    event); all timestamps become rel = abs_s - epoch_s + 1.
+
+    ``domain_resolver``: name -> domain id, applied to child/cancel/
+    signal TARGET domains captured into the side table — the host
+    oracle (StateBuilder) stores RESOLVED ids, and the transfer-task
+    consumers look targets up by id; storing raw names here would make
+    device rebuilds emit tasks whose cross-domain target can't be
+    found."""
 
     side = WorkflowSideTable(
         workflow_id=workflow_id, run_id=run_id, request_id=request_id
     )
+    resolve_domain = domain_resolver or (lambda name: name)
     if epoch_s is None:
         first = next((b[0] for b in batches if b), None)
         epoch_s = (first.timestamp // SECONDS) if first else 0
@@ -405,7 +414,9 @@ def pack_workflow(
 
             elif et == EventType.StartChildWorkflowExecutionInitiated:
                 slot = children.alloc(ev.event_id)
-                side.child_domains[slot] = a.get("domain", "")
+                side.child_domains[slot] = resolve_domain(
+                    a.get("domain", "")
+                )
                 side.child_workflow_ids[slot] = a.get("workflow_id", "")
                 side.child_types[slot] = a.get("workflow_type", "")
                 attrs[0] = hash31(a.get("workflow_id", ""))
@@ -436,7 +447,8 @@ def pack_workflow(
             elif et == EventType.RequestCancelExternalWorkflowExecutionInitiated:
                 slot = cancels.alloc(ev.event_id)
                 side.cancel_targets[slot] = (
-                    a.get("domain", ""), a.get("workflow_id", ""),
+                    resolve_domain(a.get("domain", "")),
+                    a.get("workflow_id", ""),
                     a.get("run_id", ""),
                     bool(a.get("child_workflow_only", False)),
                 )
@@ -452,7 +464,8 @@ def pack_workflow(
             elif et == EventType.SignalExternalWorkflowExecutionInitiated:
                 slot = signals.alloc(ev.event_id)
                 side.signal_targets[slot] = (
-                    a.get("domain", ""), a.get("workflow_id", ""),
+                    resolve_domain(a.get("domain", "")),
+                    a.get("workflow_id", ""),
                     a.get("run_id", ""),
                     bool(a.get("child_workflow_only", False)),
                 )
@@ -506,6 +519,7 @@ def pack_histories(
     histories: Sequence[Tuple[str, str, Sequence[Sequence[HistoryEvent]]]],
     caps: Optional[S.Capacities] = None,
     pad_batch_to: Optional[int] = None,
+    domain_resolver=None,
 ) -> PackedHistories:
     """Pack many workflows into one padded [B, T, EV_N] tensor.
 
@@ -527,7 +541,8 @@ def pack_histories(
     per_wf: List[np.ndarray] = []
     for idx, (wf_id, run_id, batches) in enumerate(histories):
         arr, st = pack_workflow(
-            batches, caps, workflow_id=wf_id, run_id=run_id, epoch_s=epoch_s
+            batches, caps, workflow_id=wf_id, run_id=run_id,
+            epoch_s=epoch_s, domain_resolver=domain_resolver,
         )
         lengths[idx] = arr.shape[0]
         side.append(st)

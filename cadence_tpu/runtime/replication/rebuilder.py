@@ -149,7 +149,7 @@ class StateRebuilder:
         # overflow etc.) falls back per-workflow to the host oracle
         chunk = self._resolve_chunk()
         out: List[Tuple[MutableState, list, list]] = []
-        d = DeviceDispatcher()
+        d = DeviceDispatcher(domain_resolver=self.domain_resolver)
         for i in range(0, len(reqs), chunk):
             d.submit(i, histories[i : i + chunk])
         d.finish()

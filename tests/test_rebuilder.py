@@ -66,3 +66,40 @@ def test_rebuild_sets_branch_token(stored):
     ms, _, _ = rebuilder.rebuild(reqs[0])
     assert ms.execution_info.branch_token == reqs[0].branch_token
     assert ms.next_event_id > 1
+
+
+def test_pack_side_tables_resolve_target_domains():
+    """r5 review: the device pack must store RESOLVED target domain ids
+    in its side tables (child/cancel/signal), matching the host oracle
+    — transfer-task consumers look targets up by id, and a raw name
+    there makes cross-domain cancels/signals undeliverable after a
+    device rebuild."""
+    from cadence_tpu.core import history_factory as F
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.pack import pack_workflow
+
+    V, t = 0, 1_700_000_000_000_000_000
+    batches = [
+        [F.workflow_execution_started(1, V, t)],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_started(3, V, t, scheduled_event_id=2)],
+        [
+            F.decision_task_completed(4, V, t, scheduled_event_id=2,
+                                      started_event_id=3),
+            F.request_cancel_external_initiated(
+                5, V, t, domain="other-dom", workflow_id="tw",
+                run_id="tr", decision_task_completed_event_id=4,
+            ),
+            F.signal_external_initiated(
+                6, V, t, domain="other-dom", workflow_id="tw",
+                run_id="tr", signal_name="s",
+                decision_task_completed_event_id=4,
+            ),
+        ],
+    ]
+    _, side = pack_workflow(
+        batches, S.Capacities(),
+        domain_resolver=lambda name: f"id-of-{name}" if name else "",
+    )
+    assert side.cancel_targets[0][0] == "id-of-other-dom"
+    assert side.signal_targets[0][0] == "id-of-other-dom"
