@@ -108,7 +108,7 @@ class StartWorkflowRequest:
 
             try:
                 validate_retry_policy(self.retry_policy)
-            except ValueError as e:
+            except (ValueError, TypeError) as e:
                 raise BadRequestError(str(e))
 
 

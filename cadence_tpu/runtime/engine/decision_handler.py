@@ -142,7 +142,10 @@ class DecisionTaskHandler:
 
             try:
                 validate_retry_policy(retry_policy)
-            except ValueError as e:
+            except (ValueError, TypeError) as e:
+                # TypeError covers non-numeric fields from loose JSON
+                # ("1" <= 0): every malformed attribute must fail the
+                # DECISION, not 500 the respond call into a crash loop
                 raise DecisionFailure(_CAUSE_BAD_SCHEDULE_ACTIVITY, str(e))
         try:
             self.txn.add_activity_task_scheduled(

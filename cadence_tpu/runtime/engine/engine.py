@@ -401,11 +401,9 @@ class HistoryEngine:
                 # them would phantom-count every SignalWithStart as a
                 # start/signal RPC too (the reference instruments at
                 # the handler boundary only)
-                raw_signal = getattr(
-                    self.signal_workflow_execution, "__wrapped__",
-                    self.signal_workflow_execution,
-                )
-                raw_signal(
+                from cadence_tpu.utils.metrics_defs import raw_method
+
+                raw_method(self.signal_workflow_execution)(
                     SignalRequest(
                         domain=start.domain,
                         workflow_id=start.workflow_id,
@@ -418,11 +416,9 @@ class HistoryEngine:
                 return run_id
         except (EntityNotExistsServiceError, EntityNotExistsError):
             pass
-        raw_start = getattr(
-            self.start_workflow_execution, "__wrapped__",
-            self.start_workflow_execution,
-        )
-        return raw_start(
+        from cadence_tpu.utils.metrics_defs import raw_method
+
+        return raw_method(self.start_workflow_execution)(
             start,
             domain_id=domain.info.id,
             signal_name=request.signal_name,
@@ -1153,10 +1149,8 @@ class HistoryEngine:
                 is_active_locally=is_active_locally,
                 task_notifier=self._task_notifier,
                 timer_notifier=self._timer_notifier,
+                rebuild_chunk_size=getattr(self, "rebuild_chunk_size", 0),
             )
-            configured = getattr(self, "rebuild_chunk_size", 0)
-            if configured:
-                self._ndc_replicator.rebuilder.chunk_size = configured
         return self._ndc_replicator
 
     @property

@@ -61,6 +61,7 @@ class NDCHistoryReplicator:
         is_active_locally=None,
         task_notifier=lambda: None,
         timer_notifier=lambda: None,
+        rebuild_chunk_size=0,
     ) -> None:
         self.shard = shard
         self.domains = domains
@@ -68,6 +69,7 @@ class NDCHistoryReplicator:
         self.rebuilder = rebuilder or StateRebuilder(
             shard.persistence.history,
             domain_resolver=self._resolve_domain,
+            chunk_size=rebuild_chunk_size,
         )
         # whether this cluster is currently active for a domain (drives
         # signal reapplication; standby clusters never mint events)

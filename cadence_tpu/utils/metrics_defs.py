@@ -88,6 +88,15 @@ LATENCY = "latency"
 ERRORS = "errors"
 
 
+def raw_method(fn):
+    """The pre-instrumentation bound method (identity if unwrapped).
+    Internal delegations use this so one RPC never phantom-counts as
+    several; unwraps through layered wrapping."""
+    while hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    return fn
+
+
 def instrument_methods(
     obj, scope: Scope, operations: Iterable[str],
 ) -> None:
