@@ -52,11 +52,16 @@ class DeviceDispatcher:
         kernel: str = "auto",
         narrow: bool = True,
         domain_resolver=None,
+        bt: int = 4096,
+        tb: int = 16,
     ) -> None:
         self.caps = caps or S.Capacities()
         # threaded into pack_workflow: side-table target domains must
         # be RESOLVED ids, matching the host oracle (StateBuilder)
         self.domain_resolver = domain_resolver
+        # pallas tile shape (serving deployments set the measured-best;
+        # tests shrink it for interpret mode)
+        self.bt, self.tb = bt, tb
         # int16 narrow event stream (replay_pallas.narrow_events_teb):
         # halves both the H2D transfer and the HBM stream the kernel is
         # bound by; falls back per batch when a gating column is wide.
@@ -180,7 +185,7 @@ class DeviceDispatcher:
                     )
                     final = replay_scan_pallas_teb(
                         state0, events, self.caps, base=nbase,
-                        wide_cols=nwide,
+                        wide_cols=nwide, bt=self.bt, tb=self.tb,
                     )
                 else:
                     from .replay import replay_scan_jit
