@@ -10,6 +10,7 @@ with identical content).
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 import tempfile
@@ -110,6 +111,11 @@ class FilestoreHistoryArchiver(HistoryArchiver):
 
 
 class FilestoreVisibilityArchiver(VisibilityArchiver):
+    # parsed-payload cache bound (files, across all domain dirs): the
+    # cache exists to kill O(N^2) re-parsing in paged scans, not to
+    # mirror an unbounded archive in memory
+    MAX_CACHED_FILES = 4096
+
     def validate_uri(self, uri: URI) -> None:
         if uri.scheme != "file" or not uri.path:
             raise InvalidURIError(f"filestore needs file://<dir>, got {uri}")
@@ -151,33 +157,46 @@ class FilestoreVisibilityArchiver(VisibilityArchiver):
         # archived visibility files are immutable (one atomic write per
         # closed run), so parse each file ONCE per archiver instance —
         # without this a paged scan re-reads every file per page
-        # (O(N^2) opens across a listing)
+        # (O(N^2) opens across a listing). Only the parsed JSON dict is
+        # cached; a fresh VisibilityRecord is constructed per call so a
+        # caller mutating a returned record (store layers decorate
+        # records in place) cannot poison every later query. Bounded by
+        # capping INSERTION at MAX_CACHED_FILES — eviction (FIFO or
+        # LRU) under a sorted sequential scan degrades to a 0% hit
+        # rate once the archive outgrows the bound; keeping the head
+        # hot and re-parsing only the tail preserves most of the win.
         cache = getattr(self, "_parsed", None)
         if cache is None:
             cache = self._parsed = {}
-        parsed = cache.setdefault(d, {})
         records: List[VisibilityRecord] = []
         if os.path.isdir(d):
             for name in sorted(os.listdir(d)):
                 if not name.endswith(".json"):
                     continue
-                rec = parsed.get(name)
-                if rec is None:
+                key = (d, name)
+                p = cache.get(key)
+                if p is None:
                     with open(os.path.join(d, name)) as f:
                         p = json.load(f)
-                    rec = parsed[name] = VisibilityRecord(
-                        domain_id=p["domain_id"],
-                        workflow_id=p["workflow_id"],
-                        run_id=p["run_id"],
-                        workflow_type=p.get("workflow_type", ""),
-                        start_time=p.get("start_time", 0),
-                        execution_time=p.get("execution_time", 0),
-                        close_time=p.get("close_time", 0),
-                        close_status=p.get("close_status", 0),
-                        history_length=p.get("history_length", 0),
-                        search_attributes=p.get("search_attributes", {}),
-                    )
-                records.append(rec)
+                    if len(cache) < self.MAX_CACHED_FILES:
+                        cache[key] = p
+                records.append(VisibilityRecord(
+                    domain_id=p["domain_id"],
+                    workflow_id=p["workflow_id"],
+                    run_id=p["run_id"],
+                    workflow_type=p.get("workflow_type", ""),
+                    start_time=p.get("start_time", 0),
+                    execution_time=p.get("execution_time", 0),
+                    close_time=p.get("close_time", 0),
+                    close_status=p.get("close_status", 0),
+                    history_length=p.get("history_length", 0),
+                    # deep copy: archives written HERE hold only scalar
+                    # values, but any *.json in the dir is read — a
+                    # nested list/dict must not alias the cached payload
+                    search_attributes=copy.deepcopy(
+                        p.get("search_attributes", {})
+                    ),
+                ))
         if page_size <= 0:
             page_size = 100  # see AdvancedVisibilityStore: a zero page
             # would return the same token forever

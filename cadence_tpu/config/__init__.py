@@ -8,7 +8,9 @@ process). See config/development.yaml for a sample.
 """
 
 from .static import (
+    ChaosConfig,
     ClusterConfig,
+    ConfigError,
     ClusterEntry,
     PersistenceConfig,
     RingConfig,
@@ -20,7 +22,9 @@ from .static import (
 from .bootstrap import RunningServer, start_services
 
 __all__ = [
+    "ChaosConfig",
     "ClusterConfig",
+    "ConfigError",
     "ClusterEntry",
     "PersistenceConfig",
     "RingConfig",

@@ -35,6 +35,12 @@ class RunningServer:
     pprof: object = None
     failure_detector: object = None
     bus: object = None
+    # the armed FaultSchedule when the config's chaos section is
+    # enabled (operators flip it via faults.arm()/disarm()); None
+    # otherwise. `metrics` is the shared Scope whose registry holds
+    # faults_injected + the injected-error counters for that run
+    faults: object = None
+    metrics: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
@@ -105,6 +111,24 @@ def start_services(
             raise ConfigError(f"unknown service '{s}'")
 
     persistence = persistence or _build_persistence(cfg)
+
+    # chaos section: fault-inject the whole persistence bundle before
+    # anything else sees it, so every service plane on this host runs
+    # against the same deterministic fault stream. The schedule, the
+    # persistence decorators, and the history service share ONE metrics
+    # scope so faults_injected and the injected-error counters land in
+    # the same registry operators already read (metrics_defs.py
+    # FAULT_METRICS promise)
+    metrics = None
+    faults = None
+    if cfg.chaos.enabled:
+        from cadence_tpu.runtime.persistence.decorators import wrap_bundle
+        from cadence_tpu.utils.metrics import Scope
+
+        metrics = Scope()
+        faults = cfg.chaos.build_schedule(metrics=metrics)
+        persistence = wrap_bundle(persistence, metrics=metrics, faults=faults)
+
     domains = DomainCache(persistence.metadata)
     cluster_metadata = cfg.build_cluster_metadata()
 
@@ -154,6 +178,8 @@ def start_services(
         # frontend/history-only host would make `admin dlq` report an
         # always-empty queue instead of "no message bus on this host"
         bus=MessageBus() if "worker" in services else None,
+        faults=faults,
+        metrics=metrics,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
     # first configured service's port wins, bound on that service's
@@ -191,6 +217,8 @@ def start_services(
             rebuild_chunk_size=dyncfg.int_property(
                 "history.rebuildChunkSize", 0
             ),
+            faults=faults,
+            metrics=metrics,
         )
         out.history = history
 

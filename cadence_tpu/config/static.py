@@ -98,6 +98,41 @@ class ClusterConfig:
 
 
 @dataclasses.dataclass
+class ChaosConfig:
+    """Deterministic fault injection (testing/faults.py), config-armed.
+
+    ``rules`` are FaultRule field dicts (site/method patterns, shard
+    pin, probability, after_calls/max_faults window, action, error,
+    latency_s). Same seed + same workload → same fault sequence. OFF by
+    default, and when off the fault decorator is never even installed —
+    a production config pays nothing for this section existing."""
+
+    enabled: bool = False
+    seed: int = 0
+    rules: List[Dict] = dataclasses.field(default_factory=list)
+
+    def validate(self) -> None:
+        if not self.enabled and not self.rules:
+            return
+        try:
+            self.build_schedule(force=True)
+        except (ValueError, TypeError) as e:
+            raise ConfigError(f"chaos.rules: {e}")
+
+    def build_schedule(self, metrics=None, force: bool = False):
+        """The FaultSchedule this section describes, or None when
+        disabled (``force`` builds regardless — validation)."""
+        if not self.enabled and not force:
+            return None
+        from cadence_tpu.testing.faults import FaultSchedule
+        from cadence_tpu.utils.metrics import NOOP
+
+        return FaultSchedule.from_dicts(
+            self.rules, seed=self.seed, metrics=metrics or NOOP
+        )
+
+
+@dataclasses.dataclass
 class ServerConfig:
     persistence: PersistenceConfig = dataclasses.field(
         default_factory=PersistenceConfig
@@ -107,12 +142,14 @@ class ServerConfig:
     )
     ring: RingConfig = dataclasses.field(default_factory=RingConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     dynamicconfig_path: str = ""
     archival_dir: str = ""
 
     def validate(self) -> None:
         self.persistence.validate()
         self.cluster.validate()
+        self.chaos.validate()
         for name in self.services:
             if name not in SERVICES:
                 raise ConfigError(f"services: unknown service '{name}'")
@@ -201,6 +238,14 @@ def load_config_dict(raw: dict) -> ServerConfig:
             }, f"clusterMetadata.clusterInformation.{name}"))
             for name, e in info.items()
         }
+
+    chaos = raw.pop("chaos", None)
+    if chaos:
+        cfg.chaos = ChaosConfig(**_take(chaos, {
+            "enabled": "enabled",
+            "seed": "seed",
+            "rules": "rules",
+        }, "chaos"))
 
     dc = raw.pop("dynamicConfig", None)
     if dc:

@@ -57,10 +57,17 @@ class MatchingEngine:
         config: Optional[Collection] = None,
         time_source: Optional[TimeSource] = None,
         metrics: Scope = NOOP,
+        poll_request_id_fn=None,
     ) -> None:
         self._store = task_manager
         self._history = history_client
         self._time = time_source or RealTimeSource()
+        # poll-delivery nonce for the started-event dedup handshake.
+        # Default: a fresh uuid per dequeued task. Injectable (called
+        # with the TaskInfo) so deterministic harnesses — the chaos
+        # suite's byte-identical differential replay — can derive it
+        # from the task instead of entropy.
+        self._poll_request_id_fn = poll_request_id_fn
         self._log = get_logger("cadence_tpu.matching")
         self.metrics = metrics.tagged(service="matching")
         # per-API requests/latency/errors (ref common/metrics/defs.go
@@ -218,7 +225,11 @@ class MatchingEngine:
                 # sync query task: no started event, no history write
                 task.finish(None)
                 return task, {"query": task.query}
-            request_id = str(uuid.uuid4())
+            request_id = (
+                self._poll_request_id_fn(info)
+                if self._poll_request_id_fn is not None
+                else str(uuid.uuid4())
+            )
             try:
                 if task_type == TASK_TYPE_DECISION:
                     resp = self._history.record_decision_task_started(

@@ -1150,6 +1150,7 @@ class HistoryEngine:
                 task_notifier=self._task_notifier,
                 timer_notifier=self._timer_notifier,
                 rebuild_chunk_size=getattr(self, "rebuild_chunk_size", 0),
+                faults=getattr(self, "faults", None),
             )
         return self._ndc_replicator
 
@@ -1165,6 +1166,7 @@ class HistoryEngine:
                     cm.enabled_remote_clusters() if cm is not None else None
                 ),
                 metrics=getattr(self, "metrics", None),
+                faults=getattr(self, "faults", None),
             )
         return self._replicator_queue
 

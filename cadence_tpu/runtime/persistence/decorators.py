@@ -86,15 +86,35 @@ class RateLimitedClient(_Wrapped):
 
 
 def wrap_bundle(bundle, metrics: Scope = NOOP,
-                max_qps: Optional[float] = None):
+                max_qps: Optional[float] = None,
+                faults=None):
     """Layer metrics (and optionally rate limits) over every manager in
-    a PersistenceBundle, mirroring persistence-factory/factory.go."""
+    a PersistenceBundle, mirroring persistence-factory/factory.go.
+
+    ``faults`` (a testing.faults.FaultSchedule) installs the fault-
+    injection client INNERMOST — under the metrics client, so injected
+    errors/latency are counted like real backend misbehavior, and under
+    the rate limiter, so an injected PersistenceBusyError surfaces to
+    the caller untranslated. Nothing is installed when it is None: the
+    default factory stack pays zero overhead for the chaos machinery.
+    """
     from .interfaces import PersistenceBundle
+
+    fault_client = None
+    if faults is not None:
+        # lazy import: the runtime layer must not depend on the testing
+        # package unless fault injection is actually configured
+        from cadence_tpu.testing.faults import FaultInjectionClient
+
+        fault_client = FaultInjectionClient
 
     def deco(mgr, name):
         if mgr is None:
             return None
-        out = MetricsClient(mgr, metrics, manager=name)
+        out = mgr
+        if fault_client is not None:
+            out = fault_client(out, faults, manager=name)
+        out = MetricsClient(out, metrics, manager=name)
         if max_qps is not None:
             out = RateLimitedClient(out, max_qps)
         return out

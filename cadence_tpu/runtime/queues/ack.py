@@ -34,6 +34,12 @@ class QueueAckManager:
         self.read_level = ack_level
         self._outstanding: Dict[object, int] = {}  # key → state
         self._update_shard_ack = update_shard_ack
+        # last level KNOWN to have persisted: a transient checkpoint
+        # failure leaves this behind ack_level, and the next sweep
+        # retries the checkpoint even if the level didn't move again
+        # (otherwise a failed final sweep would lag forever and a
+        # restart re-processes the whole span)
+        self._persisted_level = ack_level
         # cached min RETRY key (None = no retries): _bump_read_locked
         # consults it on every add(), so it must not rescan the dict
         self._retry_min = None
@@ -85,19 +91,24 @@ class QueueAckManager:
 
     def update_ack_level(self):
         """Advance over the finished prefix; checkpoint to the shard
-        only when the level actually moved. The checkpoint happens under
-        the lock so a concurrent rewind() cannot be overwritten by a
-        stale higher level."""
+        when the level moved OR a previous checkpoint failed (persisted
+        level lagging). The checkpoint happens under the lock so a
+        concurrent rewind() cannot be overwritten by a stale higher
+        level; a checkpoint error propagates (the pump logs it) with
+        the persisted marker unchanged, so the next sweep retries."""
         with self._lock:
-            before = self.ack_level
             for key in sorted(self._outstanding):
                 if self._outstanding[key] != _DONE:
                     break
                 del self._outstanding[key]
                 self.ack_level = key
             level = self.ack_level
-            if level != before and self._update_shard_ack is not None:
+            if (
+                level != self._persisted_level
+                and self._update_shard_ack is not None
+            ):
                 self._update_shard_ack(level)
+                self._persisted_level = level
         return level
 
     def rewind(self, level) -> None:
@@ -121,6 +132,7 @@ class QueueAckManager:
             self._recompute_retry_min_locked()
             if self._update_shard_ack is not None:
                 self._update_shard_ack(level)
+                self._persisted_level = level
             hook = self.on_read_rewind
         if hook is not None:
             hook()

@@ -86,9 +86,35 @@ _ATTEMPT_BACKOFF_S = (0.05, 0.2)  # between in-line attempts
 _EXHAUSTED_RETRY_DELAY_S = 5.0    # park interval after the budget
 
 
+def make_fault_hook(faults, site: str, shard_id=None):
+    """Chaos hook bound to one queue site and its shard, or None (the
+    zero-cost default) — shared by the active and standby processor
+    families so the site-naming convention can't drift between them.
+    ``shard_id`` makes shard-pinned FaultRules matchable at queue
+    sites (the replication hooks pass theirs at fire time)."""
+    if faults is None:
+        return None
+    from cadence_tpu.testing.faults import hook
+
+    return hook(faults, site, shard_id=shard_id)
+
+
+def sweep_ack(ack, log, name: str) -> None:
+    """One ack sweep that survives a transient checkpoint failure: the
+    in-memory level advanced and the ack manager retries the lagging
+    shardInfo persist on its next sweep — the pump thread must outlive
+    the error (shared by all three pump implementations)."""
+    try:
+        ack.update_ack_level()
+    except Exception:
+        log.exception(f"queue {name} ack sweep failed")
+
+
 def run_task_attempts(
     process, task, key, ack, stopped, log, scope, name,
     retry_count: int = _TASK_RETRY_COUNT,
+    exhausted_retry_delay_s: Optional[float] = None,
+    fault_hook=None,
 ) -> bool:
     """Shared queue-task attempt loop (active transfer/timer + standby
     twins — ONE copy, they had drifted). Returns True when the caller
@@ -101,11 +127,22 @@ def run_task_attempts(
     away — a sub-second dependency outage must not permanently drop a
     task (the reference never acks an errored task). A genuinely
     poisoned task retries at the defer cadence until an operator
-    removes it (admin remove-task)."""
+    removes it (admin remove-task).
+
+    ``fault_hook`` (testing.faults: the bound ``fire`` of a
+    FaultSchedule site) runs inside each attempt, so an injected fault
+    exercises exactly this backoff/park machinery; ``exhausted_retry_
+    delay_s`` lets chaos runs shrink the park interval to test-scale
+    (None = the production default)."""
+    if exhausted_retry_delay_s is None:
+        exhausted_retry_delay_s = _EXHAUSTED_RETRY_DELAY_S
+    last_exc = None
     for attempt in range(retry_count):
         if stopped.is_set():
             return False
         try:
+            if fault_hook is not None:
+                fault_hook(str(getattr(task, "task_type", "")))
             process(task)
             return True
         except DeferTask:
@@ -113,17 +150,22 @@ def run_task_attempts(
             return False
         except EntityNotExistsServiceError:
             return True  # stale task: workflow/decision moved on
-        except Exception:
+        except Exception as e:
+            last_exc = e
             scope.inc("task_errors")
             if attempt < retry_count - 1:
                 stopped.wait(_ATTEMPT_BACKOFF_S[
                     min(attempt, len(_ATTEMPT_BACKOFF_S) - 1)
                 ])
-    log.exception(
-        f"queue {name} task {key} failed {retry_count} attempts; "
-        f"parked for retry in {_EXHAUSTED_RETRY_DELAY_S}s"
+    # log.error, not log.exception: this runs OUTSIDE the except block
+    # (sys.exc_info is clear), so the final error — the operator's clue
+    # for a poisoned task — rides in the message instead
+    log.error(
+        f"queue {name} task {key} failed {retry_count} attempts "
+        f"(last: {type(last_exc).__name__}: {last_exc}); "
+        f"parked for retry in {exhausted_retry_delay_s}s"
     )
-    defer_task(ack, key, _EXHAUSTED_RETRY_DELAY_S)
+    defer_task(ack, key, exhausted_retry_delay_s)
     return False
 
 
@@ -154,9 +196,18 @@ class QueueProcessorBase:
         batch_size: int = 64,
         poll_interval_s: float = 0.05,
         metrics: Optional[Scope] = None,
+        faults=None,
+        exhausted_retry_delay_s: Optional[float] = None,
+        shard_id: Optional[int] = None,
     ) -> None:
         self.name = name
         self.ack = ack
+        # chaos hook: fired inside every task attempt under the site
+        # "queue.<name>"; None = zero-cost
+        self._fault_hook = make_fault_hook(
+            faults, f"queue.{name}", shard_id=shard_id
+        )
+        self._exhausted_retry_delay_s = exhausted_retry_delay_s
         self._read_batch = read_batch
         self._process_task = process_task
         self._complete_task = complete_task
@@ -210,7 +261,7 @@ class QueueProcessorBase:
                 self._process_batch()
             except Exception:
                 self._log.exception(f"queue {self.name} batch failed")
-            self.ack.update_ack_level()
+            sweep_ack(self.ack, self._log, self.name)
             # in-flight depth + parked depth (standby "hold depth": a
             # DeferTask-parked span wedging the ack sweep; reference
             # defs.go task-type queue gauges)
@@ -243,6 +294,8 @@ class QueueProcessorBase:
             finished = run_task_attempts(
                 self._process_task, task, key, self.ack, self._stopped,
                 self._log, scope, self.name,
+                exhausted_retry_delay_s=self._exhausted_retry_delay_s,
+                fault_hook=self._fault_hook,
             )
         if not finished:
             return  # parked (deferred / exhausted-retry) or stopping

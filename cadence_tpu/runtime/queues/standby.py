@@ -47,8 +47,10 @@ from .allocator import DeferTask, defer_task
 from .base import (
     QueueProcessorBase,
     ResumeCursor,
+    make_fault_hook,
     read_due_timers,
     run_task_attempts,
+    sweep_ack,
     timed_task,
 )
 from .timer_gate import RemoteTimerGate
@@ -211,6 +213,8 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
         local_cluster: str = "",
         on_handover=None,
         metrics=None,
+        faults=None,
+        exhausted_retry_delay_s=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -253,6 +257,9 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
             worker_count=worker_count,
             batch_size=batch_size,
             metrics=metrics,
+            faults=faults,
+            exhausted_retry_delay_s=exhausted_retry_delay_s,
+            shard_id=shard.shard_id,
         )
 
     # -- verification dispatch ----------------------------------------
@@ -396,6 +403,8 @@ class TimerQueueStandbyProcessor:
         local_cluster: str = "",
         on_handover=None,
         metrics=None,
+        faults=None,
+        exhausted_retry_delay_s=None,
     ) -> None:
         from cadence_tpu.utils.metrics import NOOP
 
@@ -403,7 +412,11 @@ class TimerQueueStandbyProcessor:
         self.engine = engine
         self.cluster = cluster
         self._on_handover = on_handover
+        self._exhausted_retry_delay_s = exhausted_retry_delay_s
         self.name = f"timer-standby-{cluster}-{shard.shard_id}"
+        self._fault_hook = make_fault_hook(
+            faults, f"queue.{self.name}", shard_id=shard.shard_id
+        )
         self._log = get_logger(
             "cadence_tpu.queue.timer-standby",
             shard=shard.shard_id, cluster=cluster,
@@ -488,7 +501,7 @@ class TimerQueueStandbyProcessor:
                 self._process_due()
             except Exception:
                 self._log.exception("standby timer pump failed")
-            self.ack.update_ack_level()
+            sweep_ack(self.ack, self._log, self.name)
             self._metrics.gauge("task_outstanding", self.ack.outstanding())
             self._metrics.gauge("task_held", self.ack.held())
 
@@ -528,6 +541,8 @@ class TimerQueueStandbyProcessor:
                 self._process, task, key, self.ack, self._stopped,
                 self._log, scope, self.name,
                 retry_count=self._TASK_RETRY_COUNT,
+                exhausted_retry_delay_s=self._exhausted_retry_delay_s,
+                fault_hook=self._fault_hook,
             )
         if not finished:
             return  # parked (deferred / exhausted-retry) or stopping

@@ -28,8 +28,10 @@ from .ack import QueueAckManager
 from .allocator import DeferTask, TaskAllocator, defer_task
 from .base import (
     ResumeCursor,
+    make_fault_hook,
     read_due_timers,
     run_task_attempts,
+    sweep_ack,
     timed_task,
 )
 from .timer_gate import LocalTimerGate
@@ -49,13 +51,19 @@ class TimerQueueProcessor:
         batch_size: int = 64,
         standby_clusters=(),
         metrics=None,
+        faults=None,
+        exhausted_retry_delay_s=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
         self.matching = matching
+        self._exhausted_retry_delay_s = exhausted_retry_delay_s
         self.standby_clusters = frozenset(standby_clusters)
         self.has_standby = bool(self.standby_clusters)
         self.name = f"timer-{shard.shard_id}"
+        self._fault_hook = make_fault_hook(
+            faults, f"queue.{self.name}", shard_id=shard.shard_id
+        )
         self._log = get_logger("cadence_tpu.queue.timer", shard=shard.shard_id)
         self._metrics = (metrics or NOOP).tagged(
             service="history_queue", queue=f"timer-{shard.shard_id}"
@@ -122,7 +130,7 @@ class TimerQueueProcessor:
                 self._process_due()
             except Exception:
                 self._log.exception("timer pump failed")
-            self.ack.update_ack_level()
+            sweep_ack(self.ack, self._log, self.name)
             self._metrics.gauge("task_outstanding", self.ack.outstanding())
             self._metrics.gauge("task_held", self.ack.held())
 
@@ -162,6 +170,8 @@ class TimerQueueProcessor:
                 self._process, task, key, self.ack, self._stopped,
                 self._log, scope, self.name,
                 retry_count=self._TASK_RETRY_COUNT,
+                exhausted_retry_delay_s=self._exhausted_retry_delay_s,
+                fault_hook=self._fault_hook,
             )
         if not finished:
             return  # parked (deferred / exhausted-retry) or stopping

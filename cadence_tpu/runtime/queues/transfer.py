@@ -73,6 +73,8 @@ class TransferQueueProcessor(QueueProcessorBase):
         batch_size: int = 64,
         standby_clusters=(),
         metrics=None,
+        faults=None,
+        exhausted_retry_delay_s=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -118,6 +120,9 @@ class TransferQueueProcessor(QueueProcessorBase):
             worker_count=worker_count,
             batch_size=batch_size,
             metrics=metrics,
+            faults=faults,
+            exhausted_retry_delay_s=exhausted_retry_delay_s,
+            shard_id=shard.shard_id,
         )
 
     # -- dispatch ------------------------------------------------------

@@ -62,10 +62,19 @@ class NDCHistoryReplicator:
         task_notifier=lambda: None,
         timer_notifier=lambda: None,
         rebuild_chunk_size=0,
+        faults=None,
     ) -> None:
         self.shard = shard
         self.domains = domains
         self.cache = cache
+        # chaos hook: fired per applied task BEFORE any mutation, so an
+        # injected fault exercises the fetcher's re-fetch/re-apply path
+        # (at-least-once), never a half-applied batch
+        from ..queues.base import make_fault_hook
+
+        self._fault_hook = make_fault_hook(
+            faults, "replication.ndc", shard_id=shard.shard_id
+        )
         self.rebuilder = rebuilder or StateRebuilder(
             shard.persistence.history,
             domain_resolver=self._resolve_domain,
@@ -98,6 +107,8 @@ class NDCHistoryReplicator:
         one device scan (``apply_events_batch``)."""
         if not task.events:
             raise ValueError("replication task has no events")
+        if self._fault_hook is not None:
+            self._fault_hook("apply_events", self.shard.shard_id)
         ctx = self.cache.get_or_create(
             task.domain_id, task.workflow_id, task.run_id
         )

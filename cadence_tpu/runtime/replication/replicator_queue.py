@@ -31,11 +31,20 @@ class ReplicatorQueueProcessor:
         batch_size: int = 100,
         remote_clusters: Optional[List[str]] = None,
         metrics=None,
+        faults=None,
     ) -> None:
         from cadence_tpu.utils.metrics import NOOP
 
         self.shard = shard
         self.batch_size = batch_size
+        # chaos hook: fired per remote fetch BEFORE the ack/read, so an
+        # injected fault leaves the cluster ack level untouched and the
+        # remote's next poll simply retries (pull model is stateless)
+        from ..queues.base import make_fault_hook
+
+        self._fault_hook = make_fault_hook(
+            faults, "replication.replicator_queue", shard_id=shard.shard_id
+        )
         self._lock = threading.Lock()
         # last task id each remote cluster has confirmed processing —
         # pre-seeded with every configured remote so one cluster's ack
@@ -156,6 +165,8 @@ class ReplicatorQueueProcessor:
         """Serve tasks after ``last_retrieved_id``; completing everything
         the remote has already confirmed (replicatorQueueProcessor.go
         getTasks: ack then read)."""
+        if self._fault_hook is not None:
+            self._fault_hook("get_replication_messages", self.shard.shard_id)
         self.ack(cluster, last_retrieved_id)
         tasks = self.shard.persistence.execution.get_replication_tasks(
             self.shard.shard_id, last_retrieved_id, self.batch_size + 1

@@ -42,6 +42,8 @@ class HistoryService:
         cluster_metadata=None,
         metrics=None,
         rebuild_chunk_size: int = 0,
+        faults=None,
+        queue_exhausted_retry_delay_s: Optional[float] = None,
     ) -> None:
         from cadence_tpu.utils.metrics import Scope
 
@@ -59,6 +61,14 @@ class HistoryService:
         # rebuild_many device-chunk rows; 0 = backend-resolved default
         # (dynamicconfig history.rebuildChunkSize via bootstrap)
         self.rebuild_chunk_size = rebuild_chunk_size
+        # chaos: a testing.faults.FaultSchedule threaded into every
+        # queue processor and the replication planes; None in any
+        # non-chaos deployment (no hook objects are even constructed).
+        # queue_exhausted_retry_delay_s shrinks the park interval so a
+        # park-then-drain chaos run completes at test-scale (None =
+        # the production default)
+        self.faults = faults
+        self._queue_park_delay_s = queue_exhausted_retry_delay_s
         self._log = get_logger(
             "cadence_tpu.history.service", host=monitor.self_identity
         )
@@ -104,6 +114,7 @@ class HistoryService:
         engine.cluster_metadata = self.cluster_metadata
         engine.metrics = self.metrics
         engine.rebuild_chunk_size = self.rebuild_chunk_size
+        engine.faults = self.faults
         engine.matching_client = self.matching_client
         has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(
@@ -111,12 +122,16 @@ class HistoryService:
             worker_count=self._queue_workers,
             standby_clusters=self.standby_clusters,
             metrics=self.metrics,
+            faults=self.faults,
+            exhausted_retry_delay_s=self._queue_park_delay_s,
         )
         timer = TimerQueueProcessor(
             shard, engine, matching=self.matching_client,
             worker_count=self._queue_workers,
             standby_clusters=self.standby_clusters,
             metrics=self.metrics,
+            faults=self.faults,
+            exhausted_retry_delay_s=self._queue_park_delay_s,
         )
         processors = [transfer, timer]
         notifiers = [transfer.notify]
@@ -138,10 +153,14 @@ class HistoryService:
             ts = TransferQueueStandbyProcessor(
                 shard, engine, cluster, local_cluster=local_cluster,
                 on_handover=transfer_handover, metrics=self.metrics,
+                faults=self.faults,
+                exhausted_retry_delay_s=self._queue_park_delay_s,
             )
             tm = TimerQueueStandbyProcessor(
                 shard, engine, cluster, local_cluster=local_cluster,
                 on_handover=timer_handover, metrics=self.metrics,
+                faults=self.faults,
+                exhausted_retry_delay_s=self._queue_park_delay_s,
             )
             processors += [ts, tm]
             notifiers.append(ts.notify)

@@ -38,8 +38,28 @@ class Onebox:
         host_identity: str = "onebox-0",
         start_worker: bool = True,
         queue_worker_count: int = 4,
+        faults=None,
+        time_source=None,
+        poll_request_id_fn=None,
     ) -> None:
+        self.faults = faults
         self.persistence = persistence or create_memory_bundle()
+        if faults is not None:
+            # chaos mode: fault-inject every persistence manager (the
+            # fault client sits innermost, under a metrics client, via
+            # wrap_bundle) — the schedule can be armed/disarmed mid-
+            # workload. The default path installs NOTHING.
+            from cadence_tpu.runtime.persistence.decorators import (
+                wrap_bundle,
+            )
+            from cadence_tpu.utils.metrics import Scope
+
+            self.metrics = Scope()
+            self.persistence = wrap_bundle(
+                self.persistence, metrics=self.metrics, faults=faults
+            )
+        else:
+            self.metrics = None
         self.bus = MessageBus()
         self.cluster_metadata = cluster_metadata or ClusterMetadata()
         self.domain_handler = DomainHandler(
@@ -52,10 +72,19 @@ class Onebox:
             num_shards, self.persistence, self.domains, self.monitor,
             cluster_metadata=self.cluster_metadata,
             queue_worker_count=queue_worker_count,
+            metrics=self.metrics,
+            faults=faults,
+            time_source=time_source,
         )
         self.history_client = HistoryClient(self.history.controller)
+        # the clock and the poll nonce are the two entropy sources a
+        # deterministic chaos run must pin: matching shares history's
+        # time source, and poll_request_id_fn replaces the per-poll
+        # uuid with a caller-derived id (see tests/test_chaos_recovery)
         self.matching = MatchingEngine(
-            self.persistence.task, self.history_client
+            self.persistence.task, self.history_client,
+            time_source=time_source,
+            poll_request_id_fn=poll_request_id_fn,
         )
         self.matching_client = MatchingClient(self.matching)
         self.history.wire(self.matching_client, self.history_client)

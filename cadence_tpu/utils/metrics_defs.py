@@ -81,6 +81,13 @@ REPLICATION_METRICS = (
     "replication_ack_lag", "replication_tasks_applied",
     "replication_apply_latency",
 )
+# chaos/fault-injection plane (testing/faults.py): every injected fault
+# increments faults_injected under tags (layer=fault_injection,
+# site=..., action=error|latency|torn_write), so a chaos run's blast
+# radius is observable in the same registry as the errors it causes —
+# the per-manager `<api>.errors.<ExcType>` counters from the metrics
+# decorator count injected and real backend failures identically.
+FAULT_METRICS = ("faults_injected",)
 
 # the standard per-operation triple
 REQUESTS = "requests"

@@ -269,12 +269,15 @@ class VisibilityQuery:
                 # must not blow up list.sort with a str-vs-int
                 # comparison — but all NUMERIC types (bool/int/float)
                 # collapse into one group so 1 sorts before 2.5, not
-                # after it by type name
+                # after it by type name. The raw value is kept (Python
+                # compares bool/int/float natively): a float() cast
+                # would collapse distinct ints above 2^53 — epoch-nanos
+                # are ~1.7e18 where float64 granularity is ~190ns
                 v = get(r)
                 if v is None:
                     return (True, "", 0)
                 if isinstance(v, (bool, int, float)):
-                    return (False, "\x00number", float(v))
+                    return (False, "\x00number", v)
                 return (False, type(v).__name__, v)
 
             out.sort(key=key, reverse=self.order_desc)

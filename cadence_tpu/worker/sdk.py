@@ -1171,12 +1171,12 @@ class Worker:
 
     def __init__(
         self, frontend, domain: str, task_list: str,
-        identity: str = "worker",
+        identity: str = "worker", sticky: bool = True,
     ) -> None:
         self.registry = WorkflowRegistry()
         self.decisions = DecisionWorker(
             frontend, domain, task_list, self.registry,
-            identity=f"{identity}-decider",
+            identity=f"{identity}-decider", sticky=sticky,
         )
         self.activities = ActivityWorker(
             frontend, domain, task_list, identity=f"{identity}-activities"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Chaos recovery suite runner.
+#
+# Default: one run at the suite's fixed seed (deterministic — the same
+# faults land in the same places every run).
+#
+#   scripts/run_chaos.sh                 # fixed seed 1234
+#   CHAOS_SEED=7 scripts/run_chaos.sh    # one specific seed
+#   CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh   # seed sweep
+#
+# Extra pytest args pass through: scripts/run_chaos.sh -k differential
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+run_one() {
+    local seed="$1"; shift
+    echo "=== chaos suite, seed ${seed} ==="
+    CHAOS_SEED="${seed}" python -m pytest tests/test_chaos_recovery.py \
+        -q -m chaos -p no:cacheprovider "$@"
+}
+
+if [[ -n "${CHAOS_SEEDS:-}" ]]; then
+    rc=0
+    for seed in ${CHAOS_SEEDS}; do
+        run_one "${seed}" "$@" || rc=$?
+    done
+    exit "${rc}"
+fi
+
+run_one "${CHAOS_SEED:-1234}" "$@"

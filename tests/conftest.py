@@ -39,6 +39,12 @@ def pytest_configure(config):
         "markers",
         "slow: long-running (interpret-mode kernels); opt in with --runslow",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection recovery suite (tests/test_chaos_recovery"
+        ".py); runs in tier-1, selectable via -m chaos "
+        "(scripts/run_chaos.sh seeds CHAOS_SEED sweeps)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,572 @@
+"""Chaos recovery suite: recovery invariants under injected faults.
+
+The fault-schedule-driven validation backbone (testing/faults.py):
+every test drives real components — the full frontend→matching→history
+stack or a live queue processor — against a seeded FaultSchedule and
+asserts a recovery invariant, not just "no crash":
+
+  * differential replay: a workflow driven to completion while
+    persistence throws on a double-digit percentage of writes must
+    produce BYTE-IDENTICAL history to a fault-free run;
+  * shard-ownership-lost mid-stream must not lose or duplicate queue
+    tasks (ack-watermark + exactly-once-completion assertions);
+  * park-on-exhaustion followed by fault clearing must drain the
+    backlog to zero;
+  * the decorator stack (fault client innermost, metrics, rate limit)
+    surfaces PersistenceBusyError untranslated and counts injected
+    faults like real backend errors.
+
+Determinism: histories are reproducible because the harness freezes
+the clock (FakeTimeSource) and pins the matching poll nonce; the fault
+sequence is reproducible because the schedule is seeded. CHAOS_SEED
+overrides the seed (scripts/run_chaos.sh sweeps it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.cluster import ClusterMetadata
+from cadence_tpu.frontend import DomainHandler, WorkflowHandler
+from cadence_tpu.matching import MatchingEngine
+from cadence_tpu.runtime.domains import DomainCache
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.decorators import (
+    MetricsClient,
+    PersistenceBusyError,
+    RateLimitedClient,
+    wrap_bundle,
+)
+from cadence_tpu.runtime.persistence.errors import PersistenceError
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.queues.ack import QueueAckManager
+from cadence_tpu.runtime.queues.base import QueueProcessorBase
+from cadence_tpu.runtime.service import HistoryService
+from cadence_tpu.runtime.api import StartWorkflowRequest
+from cadence_tpu.testing.faults import (
+    FaultInjectionClient,
+    FaultRule,
+    FaultSchedule,
+)
+from cadence_tpu.utils.clock import FakeTimeSource
+from cadence_tpu.utils.metrics import Scope
+from cadence_tpu.worker import Worker
+
+pytestmark = pytest.mark.chaos
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "1234"))
+DOMAIN = "chaos-dom"
+TL = "chaos-tl"
+
+
+# ---------------------------------------------------------------------------
+# deterministic full-stack harness
+# ---------------------------------------------------------------------------
+
+
+class ChaosBox:
+    """Frontend→matching→history with a frozen clock and a pinned poll
+    nonce, optionally fault-injected — two runs of the same workload
+    produce byte-identical histories unless a fault breaks recovery."""
+
+    def __init__(self, faults=None):
+        self.metrics = Scope()
+        self.persistence = create_memory_bundle()
+        if faults is not None:
+            self.persistence = wrap_bundle(
+                self.persistence, metrics=self.metrics, faults=faults
+            )
+        self.domain_handler = DomainHandler(
+            self.persistence.metadata, ClusterMetadata()
+        )
+        self.domains = DomainCache(self.persistence.metadata)
+        self.history = HistoryService(
+            1, self.persistence, self.domains,
+            single_host_monitor("chaos-host"),
+            time_source=FakeTimeSource(),
+            metrics=self.metrics, faults=faults,
+        )
+        hc = HistoryClient(self.history.controller)
+        self.matching = MatchingEngine(
+            self.persistence.task, hc,
+            poll_request_id_fn=(
+                lambda info: f"rid-{info.workflow_id}-{info.schedule_id}"
+            ),
+        )
+        mc = MatchingClient(self.matching)
+        self.history.wire(mc, hc)
+        self.history.start()
+        self.frontend = WorkflowHandler(
+            self.domain_handler, self.domains, hc, mc
+        )
+        self.domain_handler.register_domain(DOMAIN)
+
+    def stop(self):
+        self.history.stop()
+        self.matching.shutdown()
+
+
+def _chained_doubler(ctx, input):
+    a = yield ctx.schedule_activity("double", input)
+    b = yield ctx.schedule_activity("double", a)
+    return b
+
+
+def _drive_workflows(box, workflow_ids, timeout_s=30.0):
+    """Run the doubler workflow to completion for every id; returns the
+    canonical JSON serialization of each history."""
+    w = Worker(box.frontend, DOMAIN, TL, identity="chaos-worker",
+               sticky=False)
+    w.register_workflow("chaos-wf", _chained_doubler)
+    w.register_activity("double", lambda inp: inp * 2)
+    w.start()
+    try:
+        histories = []
+        for wid in workflow_ids:
+            run_id = box.frontend.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain=DOMAIN, workflow_id=wid,
+                    workflow_type="chaos-wf", task_list=TL, input=b"x",
+                    request_id=f"req-{wid}",
+                    execution_start_to_close_timeout_seconds=60,
+                )
+            )
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                d = box.frontend.describe_workflow_execution(
+                    DOMAIN, wid, run_id
+                )
+                if not d.is_running:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"workflow {wid} did not complete")
+            events, _ = box.frontend.get_workflow_execution_history(
+                DOMAIN, wid, run_id
+            )
+            histories.append(json.dumps(
+                [e.to_dict() for e in events], sort_keys=True, default=repr
+            ))
+        return histories
+    finally:
+        w.stop()
+
+
+def _write_fault_schedule(seed):
+    """≥10% write-fault pressure on the paths the system hardens:
+    optimistic-concurrency failures on the main execution write
+    (Update_History_Loop retries), hard errors on queue-task completion
+    (logged, never blocks the ack), and torn writes on the same
+    (write lands, response lost — the idempotency reality)."""
+    return FaultSchedule(seed=seed, rules=[
+        FaultRule(site="persistence.execution",
+                  method="update_workflow_execution",
+                  probability=0.15, error="ConditionFailedError"),
+        FaultRule(site="persistence.execution",
+                  method="complete_transfer_task",
+                  probability=0.2, error="PersistenceError"),
+        FaultRule(site="persistence.shard", method="update_shard",
+                  probability=0.2, action="torn_write",
+                  error="TimeoutError"),
+    ])
+
+
+class TestDifferentialReplay:
+    def test_history_byte_identical_under_write_faults(self):
+        """Core recovery invariant: a seeded fault storm on >10% of the
+        main persistence writes must not change a single byte of any
+        driven workflow's final history."""
+        wids = ["wf-1", "wf-2", "wf-3"]
+
+        clean_box = ChaosBox()
+        try:
+            clean = _drive_workflows(clean_box, wids)
+        finally:
+            clean_box.stop()
+
+        sched = _write_fault_schedule(CHAOS_SEED)
+        chaos_box = ChaosBox(faults=sched)
+        try:
+            faulted = _drive_workflows(chaos_box, wids)
+        finally:
+            chaos_box.stop()
+
+        # the storm actually happened (the whole point of the test)
+        update = next(
+            s for s in sched.snapshot()
+            if s["method"] == "update_workflow_execution"
+        )
+        assert update["injected"] > 0, sched.snapshot()
+        assert update["injected"] / max(1, update["matched"]) >= 0.05
+        assert sched.injected_total() >= 5, sched.snapshot()
+
+        for wid, a, b in zip(wids, clean, faulted):
+            assert a == b, f"history for {wid} diverged under faults"
+
+    def test_clean_runs_reproducible(self):
+        """Sanity floor for the differential check: two fault-free runs
+        of the harness are byte-identical (frozen clock, pinned poll
+        nonce) — without this the test above proves nothing."""
+        box1, box2 = ChaosBox(), ChaosBox()
+        try:
+            h1 = _drive_workflows(box1, ["wf-1"])
+            h2 = _drive_workflows(box2, ["wf-1"])
+        finally:
+            box1.stop()
+            box2.stop()
+        assert h1 == h2
+
+
+# ---------------------------------------------------------------------------
+# queue-task integrity under shard-ownership loss
+# ---------------------------------------------------------------------------
+
+
+class _TaskStore:
+    """Minimal ordered task queue for a bare QueueProcessorBase."""
+
+    def __init__(self, n):
+        self.tasks = [
+            SimpleNamespace(task_id=i + 1, task_type=0) for i in range(n)
+        ]
+
+    def read(self, level, batch_size):
+        return [t for t in self.tasks if t.task_id > level][:batch_size]
+
+
+def _run_queue_until_drained(store, faults, timeout_s=15.0,
+                             exhausted_retry_delay_s=0.1):
+    processed = []
+    completed = []
+    lock = threading.Lock()
+
+    def process(task):
+        with lock:
+            processed.append(task.task_id)
+
+    def complete(task):
+        with lock:
+            completed.append(task.task_id)
+
+    ack = QueueAckManager(0)
+    proc = QueueProcessorBase(
+        name="chaos", ack=ack,
+        read_batch=store.read,
+        process_task=process,
+        complete_task=complete,
+        task_key=lambda t: t.task_id,
+        worker_count=4, batch_size=16,
+        faults=faults,
+        exhausted_retry_delay_s=exhausted_retry_delay_s,
+        shard_id=3,
+    )
+    proc.start()
+    try:
+        last = store.tasks[-1].task_id
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            proc.notify()
+            if ack.update_ack_level() >= last:
+                break
+            time.sleep(0.02)
+        return processed, completed, ack
+    finally:
+        proc.stop()
+
+
+class TestShardOwnershipLostIntegrity:
+    def test_no_task_lost_or_double_completed(self):
+        """ShardOwnershipLostError on ~30% of task executions: every
+        task must still execute, complete exactly once, and the ack
+        watermark must sweep the full range — an errored task is never
+        acked away (lost) and a retried task is never completed twice
+        (duplicated). The rule is shard-pinned to the processor's shard,
+        proving the queue plane threads its shard id to the schedule."""
+        store = _TaskStore(40)
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="queue.chaos", shard_id=3, probability=0.3,
+                      error="ShardOwnershipLostError"),
+        ])
+        processed, completed, ack = _run_queue_until_drained(store, sched)
+
+        all_ids = {t.task_id for t in store.tasks}
+        assert set(processed) >= all_ids, "task lost (never executed)"
+        assert sorted(completed) == sorted(all_ids), (
+            "completion must be exactly-once per task"
+        )
+        assert ack.ack_level == store.tasks[-1].task_id
+        assert ack.outstanding() == 0 and ack.held() == 0
+        assert sched.injected_total() > 0  # the storm happened
+
+    def test_park_on_exhaustion_then_clear_drains_to_zero(self):
+        """Every attempt fails while armed → the retry budget exhausts
+        and tasks park (held, wedging the ack sweep — never acked away).
+        Disarming the schedule must let the parked retries fire and the
+        backlog drain to zero."""
+        store = _TaskStore(8)
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="queue.chaos", probability=1.0,
+                      error="PersistenceError"),
+        ])
+
+        processed = []
+        completed = []
+        lock = threading.Lock()
+
+        def process(task):
+            with lock:
+                processed.append(task.task_id)
+
+        def complete(task):
+            with lock:
+                completed.append(task.task_id)
+
+        ack = QueueAckManager(0)
+        proc = QueueProcessorBase(
+            name="chaos", ack=ack,
+            read_batch=store.read,
+            process_task=process,
+            complete_task=complete,
+            task_key=lambda t: t.task_id,
+            worker_count=2, batch_size=16,
+            faults=sched,
+            exhausted_retry_delay_s=0.1,
+        )
+        proc.start()
+        try:
+            # phase 1: armed — every task must exhaust its in-line
+            # budget and cycle through the park (DEFERRED→RETRY→re-run)
+            # machinery without ever being acked away. Parked tasks
+            # oscillate between held and re-taken, so the stable
+            # invariants are: nothing completed, the ack level pinned
+            # at 0, and every read task still accounted for.
+            deadline = time.monotonic() + 10.0
+            budget = 3 * len(store.tasks)  # one full in-line budget each
+            while time.monotonic() < deadline:
+                proc.notify()
+                if sched.injected_total() >= budget:
+                    break
+                time.sleep(0.02)
+            assert sched.injected_total() >= budget
+            assert processed == [], "armed faults must precede the handler"
+            assert ack.update_ack_level() == 0, (
+                "ack level must not pass parked (unexecuted) tasks"
+            )
+            assert completed == []
+            assert ack.outstanding() + ack.held() == len(store.tasks)
+
+            # phase 2: fault cleared — backlog must drain to zero
+            sched.disarm()
+            last = store.tasks[-1].task_id
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                proc.notify()
+                if ack.update_ack_level() >= last:
+                    break
+                time.sleep(0.02)
+            assert ack.ack_level == last, (ack.ack_level, ack.held())
+            assert sorted(completed) == [t.task_id for t in store.tasks]
+            assert ack.outstanding() == 0 and ack.held() == 0
+        finally:
+            proc.stop()
+
+
+# ---------------------------------------------------------------------------
+# decorator stack composition
+# ---------------------------------------------------------------------------
+
+
+class TestDecoratorStack:
+    def test_busy_error_propagates_untranslated_with_counters(self):
+        """Factory order (fault innermost, metrics, rate limit): an
+        injected PersistenceBusyError must surface to the caller as
+        exactly that class, and the metrics client above the fault
+        client must count it like a real backend error."""
+        scope = Scope()
+        sched = FaultSchedule(seed=CHAOS_SEED, metrics=scope, rules=[
+            FaultRule(site="persistence.metadata", method="list_domains",
+                      probability=1.0, max_faults=1,
+                      error="PersistenceBusyError"),
+        ])
+        bundle = wrap_bundle(
+            create_memory_bundle(), metrics=scope, max_qps=10_000.0,
+            faults=sched,
+        )
+        # composition is factory-ordered: RateLimited(Metrics(Fault(mgr)))
+        assert isinstance(bundle.metadata, RateLimitedClient)
+        assert isinstance(bundle.metadata._base, MetricsClient)
+        assert isinstance(bundle.metadata._base._base, FaultInjectionClient)
+
+        with pytest.raises(PersistenceBusyError):
+            bundle.metadata.list_domains()
+
+        counters = scope.registry.snapshot()["counters"]
+        assert any(
+            "list_domains.errors.PersistenceBusyError" in k
+            for k in counters
+        ), counters
+        assert any("faults_injected" in k for k in counters), counters
+
+        # max_faults=1 spent: the next call goes through untouched
+        assert bundle.metadata.list_domains() == []
+
+    def test_disabled_schedule_installs_nothing(self):
+        """Zero-cost guarantee: without a schedule the factory stack is
+        exactly what it was before the chaos subsystem existed."""
+        bundle = wrap_bundle(create_memory_bundle(), metrics=Scope())
+        assert isinstance(bundle.metadata, MetricsClient)
+        assert not isinstance(bundle.metadata._base, FaultInjectionClient)
+        assert type(bundle.metadata._base).__name__ == (
+            "MemoryMetadataManager"
+        )
+
+
+# ---------------------------------------------------------------------------
+# schedule semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSchedule:
+    def test_same_seed_same_fault_sequence(self):
+        def sequence(seed):
+            s = FaultSchedule(seed=seed, rules=[
+                FaultRule(site="persistence.*", probability=0.3),
+            ])
+            return [
+                s.plan("persistence.execution", "update", 1) is not None
+                for _ in range(200)
+            ]
+
+        assert sequence(CHAOS_SEED) == sequence(CHAOS_SEED)
+        assert sequence(CHAOS_SEED) != sequence(CHAOS_SEED + 1)
+
+    def test_latency_injection_delays_the_call(self):
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.metadata", method="list_domains",
+                      probability=1.0, action="latency", latency_s=0.05),
+        ])
+        bundle = wrap_bundle(create_memory_bundle(), faults=sched)
+        t0 = time.monotonic()
+        assert bundle.metadata.list_domains() == []
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_torn_write_lands_then_raises(self):
+        from cadence_tpu.runtime.persistence.records import (
+            DomainConfig, DomainInfo, DomainRecord, DomainReplicationConfig,
+        )
+
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.metadata", method="create_domain",
+                      probability=1.0, max_faults=1, action="torn_write",
+                      error="TimeoutError"),
+        ])
+        bundle = wrap_bundle(create_memory_bundle(), faults=sched)
+        rec = DomainRecord(
+            info=DomainInfo(id="d1", name="torn"),
+            config=DomainConfig(),
+            replication_config=DomainReplicationConfig(),
+        )
+        with pytest.raises(TimeoutError):
+            bundle.metadata.create_domain(rec)
+        # the write landed even though the caller saw a timeout
+        assert bundle.metadata.get_domain(name="torn").info.id == "d1"
+
+    def test_shard_pin_and_call_window(self):
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="q", shard_id=3, probability=1.0,
+                      after_calls=2, max_faults=2),
+        ])
+        # wrong shard never matches
+        assert sched.plan("q", "m", 7) is None
+        # first two matching calls are a grace window
+        assert sched.plan("q", "m", 3) is None
+        assert sched.plan("q", "m", 3) is None
+        # then at most max_faults fire
+        assert sched.plan("q", "m", 3) is not None
+        assert sched.plan("q", "m", 3) is not None
+        assert sched.plan("q", "m", 3) is None
+
+    def test_shard_pin_resolves_from_record_argument(self):
+        """update_shard(info, previous_range_id) carries its shard id
+        on the ShardInfo record, not as an int argument — a shard-
+        pinned rule must still resolve and fire there (otherwise a
+        pinned chaos run on persistence.shard is a silent no-op)."""
+        class _Mgr:
+            def update_shard(self, info, previous_range_id=0):
+                return "ok"
+
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.shard", method="update_shard",
+                      shard_id=3, probability=1.0,
+                      error="PersistenceError"),
+        ])
+        client = FaultInjectionClient(_Mgr(), sched, manager="shard")
+        # wrong shard passes through untouched
+        assert client.update_shard(SimpleNamespace(shard_id=7)) == "ok"
+        with pytest.raises(PersistenceError):
+            client.update_shard(SimpleNamespace(shard_id=3))
+
+    def test_replication_hook_fires_before_any_state_moves(self):
+        """The replicator-queue hook runs before the ack/read: a fetch
+        that faults must leave persistence completely untouched (the
+        pull model's at-least-once contract)."""
+        from cadence_tpu.runtime.replication.replicator_queue import (
+            ReplicatorQueueProcessor,
+        )
+
+        class _Exploding:
+            def __getattr__(self, name):
+                raise AssertionError(
+                    f"persistence touched ({name}) despite injected fault"
+                )
+
+        shard = SimpleNamespace(
+            shard_id=0, persistence=SimpleNamespace(
+                execution=_Exploding(), history=_Exploding()
+            ),
+            now=lambda: 0,
+        )
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="replication.replicator_queue", probability=1.0,
+                      error="PersistenceError"),
+        ])
+        rq = ReplicatorQueueProcessor(shard, faults=sched)
+        with pytest.raises(PersistenceError):
+            rq.get_replication_messages("remote", 0)
+
+
+class TestChaosConfig:
+    def test_config_builds_armed_schedule(self):
+        from cadence_tpu.config import load_config_dict
+
+        cfg = load_config_dict({"chaos": {
+            "enabled": True, "seed": 42,
+            "rules": [{"site": "persistence.*", "probability": 0.1}],
+        }})
+        sched = cfg.chaos.build_schedule()
+        assert sched is not None and sched.seed == 42 and sched.armed
+
+    def test_config_rejects_bad_rules(self):
+        from cadence_tpu.config import ConfigError, load_config_dict
+
+        with pytest.raises(ConfigError):
+            load_config_dict({"chaos": {
+                "enabled": True,
+                "rules": [{"site": "x", "action": "explode"}],
+            }})
+
+    def test_disabled_section_builds_nothing(self):
+        from cadence_tpu.config import load_config_dict
+
+        cfg = load_config_dict({"chaos": {
+            "enabled": False,
+            "rules": [{"site": "persistence.*"}],
+        }})
+        assert cfg.chaos.build_schedule() is None
