@@ -171,6 +171,216 @@ def _tile(packed, batch: int):
     return events, lengths
 
 
+def _pack_tiled_lanes(histories, caps, lanes: int, lane_len: int):
+    """Tile a small unique set into a full PackedLanes grid — the packed
+    analogue of ``_tile``: pack each unique once (host packing cost stays
+    O(uniques)), then fill every lane back-to-back, exactly the layout
+    ops/pack.pack_lanes produces for a homogeneous stream."""
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.pack import (
+        PackedLanes, pack_histories, round_scan_len,
+    )
+
+    ph = pack_histories(histories, caps=caps)
+    per = [
+        np.asarray(ph.events[i, : ph.lengths[i]])
+        for i in range(len(histories))
+    ]
+    t = round_scan_len(lane_len)
+    events = np.zeros((lanes, t, S.EV_N), np.int32)
+    events[:, :, S.EV_TYPE] = -1
+    seg_end = np.zeros((lanes, t), bool)
+    out_row = np.zeros((lanes, t), np.int32)
+    lengths = []
+    lane_segments = [[] for _ in range(lanes)]
+    k = 0
+    for ln in range(lanes):
+        cur = 0
+        while True:
+            arr = per[k % len(per)]
+            n = arr.shape[0]
+            if cur + n > t:
+                break
+            events[ln, cur : cur + n] = arr
+            seg_end[ln, cur + n - 1] = True
+            out_row[ln, cur + n - 1] = len(lengths)
+            lane_segments[ln].append((len(lengths), cur, cur + n))
+            lengths.append(n)
+            cur += n
+            k += 1
+    return PackedLanes(
+        events=events, seg_end=seg_end, out_row=out_row,
+        lengths=np.asarray(lengths, np.int32),
+        side=[None] * len(lengths), caps=caps, epoch_s=ph.epoch_s,
+        lane_segments=lane_segments,
+    )
+
+
+def _bench_config_packed(config: str, caps, lanes: int, lane_len: int,
+                         iters: int, baseline_histories: int):
+    """Lane-packed replay throughput (ragged time packing + depth
+    bucketing): histories ride back-to-back in each lane, so the scan
+    spends steps on real events instead of per-history padding —
+    effective scan length per history is its own depth, not the batch
+    max. The step body is statically specialized to the batch's event
+    types (replay.type_signature). mixed_depth additionally splits the
+    stream into depth buckets (ops/dispatch.depth_buckets semantics) so
+    the 10% deep stragglers don't stretch the shallow lanes."""
+    from cadence_tpu import native
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.pack import pack_histories, round_scan_len
+    from cadence_tpu.ops.refresh import refresh_tasks_device
+    from cadence_tpu.ops.replay import (
+        replay_scan, replay_scan_packed, type_signature,
+    )
+    from cadence_tpu.testing import workloads as W
+
+    rng = random.Random(43)
+    if config == "mixed_depth":
+        sh_d, dp_d = (8, 40) if SMOKE else (16, 1000)
+        shallow = [
+            (f"wf-s{i}", f"run-s{i}", W.retry_deep_history(rng, depth=sh_d))
+            for i in range(16)
+        ]
+        deep = [
+            (f"wf-d{i}", f"run-d{i}", W.retry_deep_history(rng, depth=dp_d))
+            for i in range(8)
+        ]
+        mean_sh = float(np.mean(
+            [sum(len(b) for b in h[2]) for h in shallow]))
+        mean_dp = float(np.mean(
+            [sum(len(b) for b in h[2]) for h in deep]))
+        # lane budget split for a 90/10 history mix: each class packs
+        # its own depth-bucketed lanes
+        share_d = 0.1 * mean_dp / (0.9 * mean_sh + 0.1 * mean_dp)
+        lanes_d = max(1, round(lanes * share_d))
+        lanes_s = max(1, lanes - lanes_d)
+        packs = [
+            _pack_tiled_lanes(shallow, caps, lanes_s, lane_len),
+            _pack_tiled_lanes(deep, caps, lanes_d, lane_len),
+        ]
+        uniques = shallow + deep
+        base_mix = (shallow, deep)
+    else:  # echo
+        uniques = _build_histories(config, 32, caps)
+        packs = [_pack_tiled_lanes(uniques, caps, lanes, lane_len)]
+        base_mix = None
+
+    n_hist = sum(p.n_histories for p in packs)
+    total_events = sum(p.total_events for p in packs)
+    total_cells = sum(p.lanes * p.scan_len for p in packs)
+    total_steps = sum(p.scan_len for p in packs)
+    padding_frac = (total_cells - total_events) / max(total_events, 1)
+    mean_depth = total_events / max(n_hist, 1)
+    present = set()
+    for p in packs:
+        present.update(p.present_types)
+    types = type_signature(present)
+
+    arrays = []
+    for p in packs:
+        ev, seg, row = p.time_major()
+        arrays.append((
+            jnp.asarray(ev), jnp.asarray(seg), jnp.asarray(row),
+            S.empty_state(round_scan_len(p.n_histories), caps),
+        ))
+    states0 = tuple(
+        jax.device_put(jax.tree_util.tree_map(
+            jnp.asarray, S.empty_state(p.lanes, caps)))
+        for p in packs
+    )
+
+    def step(states):
+        new_states, outs = [], []
+        for st, (ev, seg, row, out0) in zip(states, arrays):
+            out0j = jax.tree_util.tree_map(jnp.asarray, out0)
+            st2, out = replay_scan_packed(
+                st, out0j, ev, seg, row, types=types)
+            new_states.append(st2)
+            outs.append(refresh_tasks_device(out))
+        return tuple(new_states), tuple(outs)
+
+    dt, _ = _time_chained(jax.jit(step), states0, iters)
+    rate = n_hist / dt
+    results = {"xla_packed": {
+        "histories_per_sec": round(rate, 2),
+        "batch_rebuild_ms": round(dt * 1000, 3),
+        "us_per_step": round(dt / total_steps * 1e6, 3),
+        "scan_steps": total_steps,
+    }}
+
+    # ---- today's path on the same workload: one scan padded to the
+    # deepest history — the number lane packing is judged against
+    nb_u = min(512, n_hist)
+    if base_mix is not None:
+        sh, dp = base_mix
+        n_dp = max(1, round(nb_u * 0.1))
+        ev_s, len_s = _tile(pack_histories(sh, caps=caps), nb_u - n_dp)
+        ev_d, len_d = _tile(pack_histories(dp, caps=caps), n_dp)
+        events_u = np.concatenate([ev_s, ev_d], axis=0)
+        lengths_u = np.concatenate([len_s, len_d])
+    else:
+        events_u, lengths_u = _tile(
+            pack_histories(uniques, caps=caps), nb_u)
+    ev_tm_u = jnp.asarray(
+        np.ascontiguousarray(np.transpose(events_u, (1, 0, 2))))
+    state_u = jax.device_put(jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(nb_u, caps)))
+
+    def step_u(state):
+        final = replay_scan(state, ev_tm_u)
+        return final, refresh_tasks_device(final)
+
+    dt_u, _ = _time_chained(jax.jit(step_u), state_u, max(2, iters // 2))
+    unpacked_rate = nb_u / dt_u
+    padding_u = (
+        events_u.shape[0] * events_u.shape[1] - lengths_u.sum()
+    ) / max(int(lengths_u.sum()), 1)
+
+    # ---- compiled-host baseline on the same histories
+    class _Sub:
+        pass
+
+    sub = _Sub()
+    nb = min(baseline_histories, n_hist)
+    if base_mix is not None:
+        n_dp = max(1, round(nb * 0.1))
+        ev_s, len_s = _tile(pack_histories(sh, caps=caps), nb - n_dp)
+        ev_d, len_d = _tile(pack_histories(dp, caps=caps), n_dp)
+        sub.events = np.concatenate([ev_s, ev_d], axis=0)
+        sub.lengths = np.concatenate([len_s, len_d])
+    else:
+        sub.events, sub.lengths = _tile(
+            pack_histories(uniques, caps=caps), nb)
+    sub.caps = caps
+    t0 = time.perf_counter()
+    reps = 0
+    while time.perf_counter() - t0 < 0.5:
+        native.replay_sequential(sub)
+        reps += 1
+    cpp_rate = nb / ((time.perf_counter() - t0) / reps)
+
+    return {
+        "histories_per_sec": round(rate, 2),
+        "kernel": "xla_packed",
+        "packed": True,
+        "baseline_cpp_per_sec": round(cpp_rate, 2),
+        "vs_baseline": round(rate / cpp_rate, 2),
+        "mean_depth": round(mean_depth, 1),
+        "batch_rebuild_ms": round(dt * 1000, 3),
+        "batch": n_hist,
+        "lanes": sum(p.lanes for p in packs),
+        "buckets": len(packs),
+        "padding_frac": round(padding_frac, 4),
+        "lanes_per_history": round(
+            sum(p.lanes for p in packs) / max(n_hist, 1), 4),
+        "unpacked_histories_per_sec": round(unpacked_rate, 2),
+        "unpacked_padding_frac": round(float(padding_u), 4),
+        "vs_unpacked": round(rate / unpacked_rate, 2),
+        "kernels": results,
+    }
+
+
 def _checksum(state):
     acc = jnp.int32(0)
     for leaf in jax.tree_util.tree_leaves(state):
@@ -228,13 +438,17 @@ def _bench_config(config: str, caps, batch: int, iters: int,
     from cadence_tpu.ops import schema as S
     from cadence_tpu.ops.pack import pack_histories
     from cadence_tpu.ops.refresh import refresh_tasks_device
-    from cadence_tpu.ops.replay import replay_scan
+    from cadence_tpu.ops.replay import replay_scan, type_signature
     from cadence_tpu.ops.replay_pallas import replay_scan_pallas_teb
 
     n_unique = min(32, batch)
     packed = pack_histories(_build_histories(config, n_unique, caps),
                             caps=caps)
     events, lengths = _tile(packed, batch)
+    # static event-type specialization, exactly as the serving
+    # dispatcher applies it (DeviceDispatcher._type_set)
+    types = type_signature(
+        int(t) for t in np.unique(events[:, :, S.EV_TYPE]) if t >= 0)
     mean_depth = float(lengths.mean())
     T = events.shape[1]
     state0 = jax.device_put(
@@ -249,7 +463,7 @@ def _bench_config(config: str, caps, batch: int, iters: int,
     ev_tm = jnp.asarray(np.ascontiguousarray(np.transpose(events, (1, 0, 2))))
 
     def step_xla(state):
-        final = replay_scan(state, ev_tm)
+        final = replay_scan(state, ev_tm, types=types)
         return final, refresh_tasks_device(final)
 
     dt, cs_xla = _time_chained(jax.jit(step_xla), state0, iters)
@@ -413,6 +627,12 @@ def _bench_config(config: str, caps, batch: int, iters: int,
         "batch_rebuild_ms": round(batch / headline_rate * 1000, 3),
         "batch_rebuild_ms_unchained": best["batch_rebuild_ms"],
         "batch": batch,
+        # padded steps ÷ real events: the per-lane padding waste the
+        # lane-packed configs eliminate (one history per lane here)
+        "padding_frac": round(
+            float(batch * T - lengths.sum()) / max(int(lengths.sum()), 1),
+            4),
+        "lanes_per_history": 1.0,
         "kernels": results,
     }
 
@@ -451,11 +671,30 @@ def main() -> None:
     # per-config capacities: sized to the workload (slot tables directly
     # set HBM bytes/step for the XLA kernel and VMEM rows for Pallas)
     CONFIGS = {
+        # echo rides the lane-packed path: ~23 whole 11-event histories
+        # per 256-step lane instead of one 11-event history per 16-step
+        # lane — the scan replays ~16/11x more real events per step and
+        # the type-specialized step body skips the transition blocks an
+        # echo storm never touches
         "echo": dict(
             caps=S.Capacities(max_events=16, max_activities=2, max_timers=2,
                               max_children=2, max_request_cancels=2,
                               max_signals_ext=2, max_version_items=2),
-            batch=512 * scale, baseline=2048),
+            batch=512 * scale, baseline=2048,
+            # column-layout per-step cost grows sublinearly in lanes, so
+            # the packed grid uses the widest batch that still fits the
+            # bench wall (~47k whole histories per 256-step scan)
+            packed=dict(lanes=min(2048 * scale, 8192), lane_len=256)),
+        # 90% depth-16 / 10% depth-1k: the depth-bucketed dispatch
+        # configuration — without bucketing+packing every lane pads to
+        # the 1k stragglers (unpacked_histories_per_sec reports that)
+        "mixed_depth": dict(
+            caps=S.Capacities(max_events=1024, max_activities=4,
+                              max_timers=2, max_children=2,
+                              max_request_cancels=2, max_signals_ext=2,
+                              max_version_items=2),
+            batch=512 * scale, baseline=512,
+            packed=dict(lanes=min(512 * scale, 4096), lane_len=1024)),
         "signal": dict(
             caps=S.Capacities(max_events=512, max_activities=2, max_timers=2,
                               max_children=2, max_request_cancels=2,
@@ -477,12 +716,19 @@ def main() -> None:
     }
 
     if SMOKE:
-        # harness-coverage shapes: one config, tiny tensors, seconds on CPU
-        CONFIGS = {"retry_deep": dict(
-            caps=S.Capacities(max_events=64, max_activities=4, max_timers=2,
-                              max_children=2, max_request_cancels=2,
-                              max_signals_ext=2, max_version_items=2),
-            batch=32, baseline=32)}
+        # harness-coverage shapes: tiny tensors, seconds on CPU — one
+        # unpacked config plus one lane-packed/bucketed config so the
+        # packer's padding_frac contract stays under tier-1 coverage
+        smoke_caps = S.Capacities(
+            max_events=64, max_activities=4, max_timers=2,
+            max_children=2, max_request_cancels=2,
+            max_signals_ext=2, max_version_items=2)
+        CONFIGS = {
+            "retry_deep": dict(caps=smoke_caps, batch=32, baseline=32),
+            "mixed_depth": dict(
+                caps=smoke_caps, batch=32, baseline=32,
+                packed=dict(lanes=8, lane_len=64)),
+        }
 
     copy_bw = measure_copy_bw_gbps() if not on_cpu else None
 
@@ -505,13 +751,18 @@ def main() -> None:
         ):
             results[config] = {"skipped": "bench budget exhausted"}
             continue
-        results[config] = _bench_config(
-            config, cfg["caps"], cfg["batch"], iters, cfg["baseline"],
-            bt, tb, use_pallas,
-            chain=int(os.environ.get(
-                "BENCH_CHAIN",
-                "4" if (config == "retry_deep" and use_pallas) else "1",
-            )))
+        if "packed" in cfg:
+            results[config] = _bench_config_packed(
+                config, cfg["caps"], cfg["packed"]["lanes"],
+                cfg["packed"]["lane_len"], iters, cfg["baseline"])
+        else:
+            results[config] = _bench_config(
+                config, cfg["caps"], cfg["batch"], iters, cfg["baseline"],
+                bt, tb, use_pallas,
+                chain=int(os.environ.get(
+                    "BENCH_CHAIN",
+                    "4" if (config == "retry_deep" and use_pallas) else "1",
+                )))
 
     head = results["retry_deep"]
     out = {

@@ -10,6 +10,22 @@ and the jitted replay call return immediately, so pack(k+1) runs on the
 CPU while replay(k) runs on the device, and the bounded stage queue
 (``depth``) provides the double-buffer backpressure.
 
+Two storm levers ride on top of the pipeline:
+
+* **ragged lane packing** (``lane_pack=True``): the pack pump calls
+  ops/pack.pack_lanes so several whole histories share each scan lane,
+  and the run pump uses the packed scan (segment-end scatter + lane
+  reset) — effective scan length per history is its own depth, not
+  ``max(depth)`` over the chunk;
+* **depth bucketing** (``replay_stream(bucket=True)`` /
+  ``depth_buckets``): histories sort into geometric depth classes
+  first, so a few deep stragglers don't stretch every lane.
+
+Batch width, scan length, and the packed scan's static event-type
+signature are all rounded/grown monotonically (``round_scan_len``,
+``_type_set``) so a storm of arbitrary chunk shapes compiles a bounded
+set of executables.
+
 Used by the replication rebuild path for storm-sized request streams
 (runtime/replication/rebuilder.py rebuild_many) and usable standalone::
 
@@ -28,6 +44,7 @@ import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 from . import schema as S
+from .pack import round_scan_len
 
 
 class DispatchError(Exception):
@@ -35,6 +52,39 @@ class DispatchError(Exception):
         super().__init__(f"batch {batch_id}: {cause!r}")
         self.batch_id = batch_id
         self.cause = cause
+
+
+def history_depth(batches) -> int:
+    """Total event count of one history (its replay depth)."""
+    return sum(len(b) for b in batches)
+
+
+def depth_buckets(
+    histories: Sequence[Tuple],
+) -> List[Tuple[Tuple[int, ...], List[Tuple]]]:
+    """Sort histories by depth and group them into geometric depth
+    buckets (``round_scan_len`` grid), shallowest first.
+
+    A handful of deep stragglers in a mixed batch no longer stretch
+    every lane: each bucket packs lanes sized for its own depth class.
+    Returns ``[(original_indices, bucket_histories), ...]`` so callers
+    can reassemble results in submission order.
+    """
+    keyed = sorted(
+        range(len(histories)),
+        key=lambda i: (round_scan_len(history_depth(histories[i][2])), i),
+    )
+    out: List[Tuple[Tuple[int, ...], List[Tuple]]] = []
+    cur_key = None
+    for i in keyed:
+        key = round_scan_len(history_depth(histories[i][2]))
+        if key != cur_key:
+            out.append(((), []))
+            cur_key = key
+        idxs, hs = out[-1]
+        out[-1] = (idxs + (i,), hs)
+        hs.append(histories[i])
+    return out
 
 
 class DeviceDispatcher:
@@ -54,6 +104,8 @@ class DeviceDispatcher:
         domain_resolver=None,
         bt: int = 4096,
         tb: int = 16,
+        lane_pack: bool = False,
+        lane_len: Optional[int] = None,
     ) -> None:
         self.caps = caps or S.Capacities()
         # threaded into pack_workflow: side-table target domains must
@@ -62,6 +114,13 @@ class DeviceDispatcher:
         # pallas tile shape (serving deployments set the measured-best;
         # tests shrink it for interpret mode)
         self.bt, self.tb = bt, tb
+        # ragged lane packing (ops/pack.py pack_lanes): several whole
+        # histories per scan lane; effective scan length becomes
+        # ≈ total_events / lanes instead of max(depth). lane_len is the
+        # lane capacity in events (None = one history per lane density,
+        # i.e. the longest history in each batch)
+        self.lane_pack = lane_pack
+        self.lane_len = lane_len
         # int16 narrow event stream (replay_pallas.narrow_events_teb):
         # halves both the H2D transfer and the HBM stream the kernel is
         # bound by; falls back per batch when a gating column is wide.
@@ -69,6 +128,10 @@ class DeviceDispatcher:
         # so the kernel specialization key stays stable mid-storm
         self.narrow = narrow
         self._wide_set: set = set()
+        # present-event-type union across batches: the packed scan's
+        # static specialization key (replay.type_signature) — grows
+        # monotonically like _wide_set so it can't recompile mid-storm
+        self._type_set: set = set()
         self._in: "queue.Queue" = queue.Queue()
         self._staged: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._out: "queue.Queue" = queue.Queue()
@@ -127,42 +190,105 @@ class DeviceDispatcher:
                 return
             batch_id, histories = item
             try:
-                packed = pack_histories(
-                    histories, caps=self.caps,
-                    domain_resolver=self.domain_resolver,
-                )
-                narrow_meta = None
-                if use_pallas:
-                    teb = packed.teb()
-                    narrowed = None
-                    if self.narrow:
-                        from .replay_pallas import narrow_events_teb
-
-                        narrowed = narrow_events_teb(
-                            teb, force_wide=tuple(sorted(self._wide_set))
-                        )
-                    if narrowed is not None:
-                        ev16, nbase, nwide = narrowed
-                        self._wide_set.update(nwide)
-                        events = jax.device_put(jnp.asarray(ev16))
-                        narrow_meta = (nbase, nwide)
-                    else:
-                        events = jax.device_put(jnp.asarray(teb))
-                else:
-                    events = jax.device_put(
-                        jnp.asarray(packed.time_major())
+                if self.lane_pack:
+                    staged = self._pack_lanes_item(
+                        batch_id, histories, use_pallas, jax, jnp
                     )
-                state0 = jax.tree_util.tree_map(
-                    jnp.asarray,
-                    S.empty_state(packed.batch, self.caps),
-                )
+                else:
+                    staged = self._pack_hist_item(
+                        batch_id, histories, use_pallas, jax, jnp
+                    )
                 # blocks when `depth` batches are already staged — the
                 # double-buffer backpressure
-                self._staged.put(
-                    (batch_id, packed, events, narrow_meta, state0)
-                )
+                self._staged.put(staged)
             except Exception as e:
                 self._staged.put(DispatchError(batch_id, e))
+
+    def _pack_hist_item(self, batch_id, histories, use_pallas, jax, jnp):
+        from .pack import pack_histories
+
+        b = len(histories)
+        # grid-rounded batch: distinct stream chunk sizes would
+        # otherwise each compile a fresh replay executable mid-storm
+        packed = pack_histories(
+            histories, caps=self.caps, pad_batch_to=round_scan_len(b),
+            domain_resolver=self.domain_resolver,
+        )
+        narrow_meta = None
+        if use_pallas:
+            teb = packed.teb()
+            narrowed = None
+            if self.narrow:
+                from .replay_pallas import narrow_events_teb
+
+                narrowed = narrow_events_teb(
+                    teb, force_wide=tuple(sorted(self._wide_set))
+                )
+            if narrowed is not None:
+                ev16, nbase, nwide = narrowed
+                self._wide_set.update(nwide)
+                events = jax.device_put(jnp.asarray(ev16))
+                narrow_meta = (nbase, nwide)
+            else:
+                events = jax.device_put(jnp.asarray(teb))
+        else:
+            events = jax.device_put(jnp.asarray(packed.time_major()))
+        state0 = jax.tree_util.tree_map(
+            jnp.asarray, S.empty_state(packed.batch, self.caps)
+        )
+        return ("hist", batch_id, packed, events, narrow_meta, state0, b)
+
+    def _pack_lanes_item(self, batch_id, histories, use_pallas, jax, jnp):
+        from .pack import pack_lanes
+        from .replay import type_signature
+
+        packed = pack_lanes(
+            histories, caps=self.caps, target_lane_len=self.lane_len,
+            seg_align=self.tb if use_pallas else 1,
+            domain_resolver=self.domain_resolver,
+        )
+        self._type_set.update(packed.present_types)
+        sig = type_signature(self._type_set)
+        narrow_meta = None
+        if use_pallas:
+            teb = packed.teb()
+            narrowed = None
+            if self.narrow:
+                from .replay_pallas import narrow_events_teb
+
+                narrowed = narrow_events_teb(
+                    teb, force_wide=tuple(sorted(self._wide_set))
+                )
+            if narrowed is not None:
+                ev16, nbase, nwide = narrowed
+                self._wide_set.update(nwide)
+                events = jax.device_put(jnp.asarray(ev16))
+                narrow_meta = (nbase, nwide)
+            else:
+                events = jax.device_put(jnp.asarray(teb))
+            arrays = (
+                events,
+                jnp.asarray(packed.seg_end),
+                jnp.asarray(packed.out_row),
+            )
+        else:
+            ev_tm, seg_tm, row_tm = packed.time_major()
+            arrays = (
+                jax.device_put(jnp.asarray(ev_tm)),
+                jnp.asarray(seg_tm),
+                jnp.asarray(row_tm),
+            )
+        state0 = jax.tree_util.tree_map(
+            jnp.asarray, S.empty_state(packed.lanes, self.caps)
+        )
+        out0 = jax.tree_util.tree_map(
+            jnp.asarray,
+            S.empty_state(round_scan_len(packed.n_histories), self.caps),
+        )
+        return (
+            "lanes", batch_id, packed, arrays, state0, out0, sig,
+            narrow_meta,
+        )
 
     def _run_pump(self) -> None:
         use_pallas = self._use_pallas()
@@ -174,25 +300,62 @@ class DeviceDispatcher:
             if isinstance(item, DispatchError):
                 self._out.put(item)
                 continue
-            batch_id, packed, events, narrow_meta, state0 = item
+            mode, batch_id = item[0], item[1]
             try:
-                if use_pallas:
-                    from .replay_pallas import replay_scan_pallas_teb
+                if mode == "lanes":
+                    (_, _, packed, arrays, state0, out0, sig,
+                     narrow_meta) = item
+                    if use_pallas:
+                        from .replay_pallas import replay_scan_pallas_packed
 
-                    nbase, nwide = (
-                        narrow_meta if narrow_meta is not None
-                        else (None, ())
-                    )
-                    final = replay_scan_pallas_teb(
-                        state0, events, self.caps, base=nbase,
-                        wide_cols=nwide, bt=self.bt, tb=self.tb,
+                        nbase, nwide = (
+                            narrow_meta if narrow_meta is not None
+                            else (None, ())
+                        )
+                        _, final = replay_scan_pallas_packed(
+                            state0, out0, *arrays, self.caps,
+                            tb=self.tb, bt=self.bt, base=nbase,
+                            wide_cols=nwide,
+                        )
+                    else:
+                        from .replay import replay_scan_packed_jit
+
+                        _, final = replay_scan_packed_jit(
+                            state0, out0, *arrays, types=sig
+                        )
+                    import jax
+
+                    final = jax.tree_util.tree_map(
+                        lambda x: x[: packed.n_histories], final
                     )
                 else:
-                    from .replay import replay_scan_jit
+                    _, _, packed, events, narrow_meta, state0, b = item
+                    if use_pallas:
+                        from .replay_pallas import replay_scan_pallas_teb
 
-                    # the jitted form donates state0's buffer and skips
-                    # per-batch retracing on this hot storm-drain path
-                    final = replay_scan_jit(state0, events)
+                        nbase, nwide = (
+                            narrow_meta if narrow_meta is not None
+                            else (None, ())
+                        )
+                        final = replay_scan_pallas_teb(
+                            state0, events, self.caps, base=nbase,
+                            wide_cols=nwide, bt=self.bt, tb=self.tb,
+                        )
+                    else:
+                        from .replay import replay_scan_jit
+
+                        # the jitted form donates state0's buffer and
+                        # skips per-batch retracing on this hot
+                        # storm-drain path
+                        final = replay_scan_jit(state0, events)
+                    if b < packed.batch:
+                        import jax
+
+                        # grid padding is an implementation detail; the
+                        # consumer sees exactly its submitted batch
+                        final = jax.tree_util.tree_map(
+                            lambda x: x[:b], final
+                        )
                 # async dispatch: the call returns while the device
                 # works; the next H2D/pack proceeds immediately
                 self._out.put((batch_id, packed, final))
@@ -217,7 +380,10 @@ class DeviceDispatcher:
         A failed batch raises its DispatchError when its turn comes
         (strict, default) or is yielded as the DispatchError itself
         (strict=False) so the caller can fall back per batch and keep
-        consuming.
+        consuming. On a strict raise the remaining staged/out queues are
+        drained in the background first — the consumer abandons the
+        iterator at the raise, and without the drain the pack pump
+        could block forever on a full ``_staged`` queue.
         """
         while True:
             item = self._out.get()
@@ -226,10 +392,27 @@ class DeviceDispatcher:
                 return
             if isinstance(item, DispatchError):
                 if strict:
+                    self._drain_async()
                     raise item
                 yield item
                 continue
             yield item
+
+    def _drain_async(self) -> None:
+        """Consume everything still in flight on a daemon thread so the
+        pumps run to completion and exit; idempotent."""
+        if self._drained:
+            return
+        self._drained = True
+        self.finish()
+
+        def _run() -> None:
+            while self._out.get() is not None:
+                pass
+
+        threading.Thread(
+            target=_run, name="dispatch-drain", daemon=True
+        ).start()
 
     def __enter__(self) -> "DeviceDispatcher":
         return self
@@ -250,15 +433,43 @@ def replay_stream(
     batch_size: int = 4096,
     depth: int = 2,
     kernel: str = "auto",
+    lane_pack: bool = False,
+    lane_len: Optional[int] = None,
+    bucket: bool = False,
 ) -> List[Tuple]:
     """Replay a large history stream through the pipelined dispatcher.
 
     Splits ``histories`` into ``batch_size`` chunks and returns
     [(packed, final_state), ...] in order — the storm-drain entry the
     replication rebuilder uses.
+
+    ``bucket=True`` (implies lane packing) sorts the stream into
+    geometric depth buckets first, so mixed-depth storms don't pad every
+    lane to the deepest straggler; the return value then carries the
+    original indices per batch: [(indices, packed, final_state), ...]
+    where row j of ``final_state`` is history ``indices[j]``.
     """
     out: List[Tuple] = []
-    d = DeviceDispatcher(caps=caps, depth=depth, kernel=kernel)
+    if bucket:
+        d = DeviceDispatcher(
+            caps=caps, depth=depth, kernel=kernel, lane_pack=True,
+            lane_len=lane_len,
+        )
+        n = 0
+        for idxs, hs in depth_buckets(histories):
+            for j in range(0, len(hs), batch_size):
+                d.submit(idxs[j : j + batch_size], hs[j : j + batch_size])
+                n += 1
+        if n == 0:
+            return out
+        d.finish()
+        for idxs, packed, final in d.results():
+            out.append((idxs, packed, final))
+        return out
+    d = DeviceDispatcher(
+        caps=caps, depth=depth, kernel=kernel, lane_pack=lane_pack,
+        lane_len=lane_len,
+    )
     n = 0
     for i in range(0, len(histories), batch_size):
         d.submit(i, histories[i : i + batch_size])

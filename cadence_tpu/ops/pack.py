@@ -43,6 +43,23 @@ SECONDS = 1_000_000_000  # ns per second
 _INT32_MAX = 2**31 - 1
 
 
+def round_scan_len(n: int, floor: int = 8) -> int:
+    """Round ``n`` up to the {2^k, 3·2^(k-1)} geometric grid.
+
+    Scan length and batch width are jit specialization keys: rounding
+    them to this grid bounds how many executables a storm of
+    arbitrary-sized batches can force (≤ 2 per octave) at < 50% padding
+    worst case (just past a power of two), ~20% expected.
+    """
+    if n <= floor:
+        return floor
+    k = (n - 1).bit_length()
+    p = 1 << k
+    if 3 * (p >> 2) >= n:
+        return 3 * (p >> 2)
+    return p
+
+
 class PackError(Exception):
     """History cannot be packed (malformed event stream)."""
 
@@ -567,6 +584,195 @@ def pack_histories(
     return PackedHistories(
         events=events, lengths=lengths, side=side, caps=caps,
         epoch_s=epoch_s, rows_concat=rows_concat,
+    )
+
+
+@dataclasses.dataclass
+class PackedLanes:
+    """Ragged lane-packed batch: multiple whole histories back-to-back in
+    each scan lane (sequence packing for the replay kernel).
+
+    Where :class:`PackedHistories` pads every history to the deepest one
+    in the batch, this layout packs segments (whole histories) end to end
+    so the effective scan length per history is its own depth, not
+    ``max(depth)``. Each segment's last (possibly padded) row carries a
+    segment-end flag and a precomputed output snapshot row; the kernel
+    scatters the lane's state there and resets the lane to
+    ``empty_state`` — bit-identically to replaying the segment alone
+    (tests/test_replay_differential.py::TestLanePacking).
+    """
+
+    events: np.ndarray       # [L, T, EV_N] int32 (-1 type = padding)
+    seg_end: np.ndarray      # [L, T] bool — last row of each segment
+    out_row: np.ndarray      # [L, T] int32 — snapshot row at seg-end rows
+    lengths: np.ndarray      # [n_histories] int32 — real events per history
+    side: List[WorkflowSideTable]  # indexed by output row (input order)
+    caps: S.Capacities
+    epoch_s: int = 0
+    # per-lane segment table: (out_row, start, end_excl) with end_excl
+    # including seg_align padding — how ops/unpack.py splits snapshots
+    lane_segments: List[List[Tuple[int, int, int]]] = dataclasses.field(
+        default_factory=list
+    )
+    seg_align: int = 1
+
+    @property
+    def n_histories(self) -> int:
+        return len(self.lengths)
+
+    @property
+    def lanes(self) -> int:
+        return self.events.shape[0]
+
+    @property
+    def scan_len(self) -> int:
+        return self.events.shape[1]
+
+    @property
+    def total_events(self) -> int:
+        return int(self.lengths.sum())
+
+    @property
+    def padding_frac(self) -> float:
+        """Padded steps ÷ real events — the waste the packer removes."""
+        real = self.total_events
+        if not real:
+            return 0.0
+        return (self.lanes * self.scan_len - real) / real
+
+    @property
+    def lanes_per_history(self) -> float:
+        n = self.n_histories
+        return self.lanes / n if n else 0.0
+
+    @property
+    def present_types(self) -> Tuple[int, ...]:
+        """Sorted event types occurring in this batch — feed through
+        ops.replay.type_signature to statically specialize the scan."""
+        et = np.unique(self.events[:, :, S.EV_TYPE])
+        return tuple(int(t) for t in et if t >= 0)
+
+    def time_major(self):
+        """(events [T, L, EV_N], seg_end [T, L], out_row [T, L]) — the
+        layout replay_scan_packed consumes."""
+        ev = np.ascontiguousarray(np.transpose(self.events, (1, 0, 2)))
+        return ev, self.seg_end.T.copy(), self.out_row.T.copy()
+
+    def teb(self) -> np.ndarray:
+        """[T, EV_N, L] field-major for the Pallas packed path."""
+        return np.ascontiguousarray(np.transpose(self.events, (1, 2, 0)))
+
+
+def pack_lanes(
+    histories: Sequence[Tuple[str, str, Sequence[Sequence[HistoryEvent]]]],
+    caps: Optional[S.Capacities] = None,
+    target_lane_len: Optional[int] = None,
+    seg_align: int = 1,
+    pad_lanes_to: Optional[int] = None,
+    round_lengths: bool = True,
+    domain_resolver=None,
+) -> PackedLanes:
+    """Greedy first-fit lane packing of many workflow histories.
+
+    ``target_lane_len``: lane capacity in events; histories are packed
+    back-to-back up to it (a history longer than the target still gets a
+    lane — the final scan length is the longest lane, grid-rounded).
+    Defaults to the longest single history, i.e. one history per lane,
+    matching :func:`pack_histories` density.
+
+    ``seg_align``: segment starts/ends are padded to this multiple — the
+    Pallas packed kernel flushes snapshots at time-block boundaries, so
+    its callers pack with ``seg_align == tb``. Padding rows are no-ops
+    (EV_TYPE −1), so the aligned snapshot equals the unaligned one.
+
+    Output rows follow the input order: ``out_row`` i and ``side[i]``
+    belong to ``histories[i]`` whatever lane its segment landed in.
+    """
+    caps = caps or S.Capacities()
+    if seg_align < 1:
+        raise ValueError(f"seg_align must be >= 1, got {seg_align}")
+    n = len(histories)
+    first_ts = [
+        batches[0][0].timestamp
+        for _, _, batches in histories
+        if batches and batches[0]
+    ]
+    epoch_s = min(first_ts) // SECONDS if first_ts else 0
+    per_wf: List[np.ndarray] = []
+    side: List[WorkflowSideTable] = []
+    lengths = np.zeros((n,), dtype=np.int32)
+    seg_lens: List[int] = []
+    for idx, (wf_id, run_id, batches) in enumerate(histories):
+        arr, st = pack_workflow(
+            batches, caps, workflow_id=wf_id, run_id=run_id,
+            epoch_s=epoch_s, domain_resolver=domain_resolver,
+        )
+        per_wf.append(arr)
+        side.append(st)
+        lengths[idx] = arr.shape[0]
+        seg_lens.append(-(-arr.shape[0] // seg_align) * seg_align)
+
+    max_seg = max(seg_lens, default=seg_align)
+    cap_t = max(target_lane_len or 0, max_seg)
+
+    # greedy first-fit in ascending-length order (original index breaks
+    # ties) — lanes too small for the current segment can never fit a
+    # later one, so they drop out of the open set and the fit stays
+    # O(n + lanes) even for storm-sized batches
+    order = sorted(range(n), key=lambda i: (seg_lens[i], i))
+    lane_fill: List[int] = []          # events used per lane
+    assign: List[List[int]] = []       # history indices per lane
+    open_lanes: List[int] = []
+    for i in order:
+        seg = seg_lens[i]
+        placed = None
+        still_open: List[int] = []
+        for ln in open_lanes:
+            if placed is None and lane_fill[ln] + seg <= cap_t:
+                placed = ln
+            if lane_fill[ln] + seg <= cap_t or ln == placed:
+                still_open.append(ln)
+        open_lanes = still_open
+        if placed is None:
+            placed = len(lane_fill)
+            lane_fill.append(0)
+            assign.append([])
+            open_lanes.append(placed)
+        lane_fill[placed] += seg
+        assign[placed].append(i)
+
+    n_lanes = max(len(lane_fill), 1)
+    t = max(lane_fill, default=seg_align)
+    t = round_scan_len(t) if round_lengths else t
+    # the Pallas packed path needs scan length divisible by the block
+    # (= seg_align); grid points like 12/24/48 may not be
+    t = -(-t // seg_align) * seg_align
+    lanes = round_scan_len(max(pad_lanes_to or 0, n_lanes)) \
+        if round_lengths else max(pad_lanes_to or 0, n_lanes)
+
+    events = np.full((lanes, t, S.EV_N), 0, dtype=np.int32)
+    events[:, :, S.EV_TYPE] = -1
+    seg_end = np.zeros((lanes, t), dtype=bool)
+    out_row = np.zeros((lanes, t), dtype=np.int32)
+    lane_segments: List[List[Tuple[int, int, int]]] = [
+        [] for _ in range(lanes)
+    ]
+    for ln, members in enumerate(assign):
+        cursor = 0
+        for i in members:
+            arr = per_wf[i]
+            events[ln, cursor : cursor + arr.shape[0]] = arr
+            end = cursor + seg_lens[i]
+            seg_end[ln, end - 1] = True
+            out_row[ln, end - 1] = i
+            lane_segments[ln].append((i, cursor, end))
+            cursor = end
+
+    events.flags.writeable = False
+    return PackedLanes(
+        events=events, seg_end=seg_end, out_row=out_row, lengths=lengths,
+        side=side, caps=caps, epoch_s=epoch_s,
+        lane_segments=lane_segments, seg_align=seg_align,
     )
 
 

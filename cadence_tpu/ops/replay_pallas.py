@@ -47,6 +47,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams across jax releases
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 from cadence_tpu.core.enums import CloseStatus, EventType as E, TimeoutType, WorkflowState
 from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION
 
@@ -722,7 +727,7 @@ def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
                                memory_space=pltpu.VMEM),
         # double-buffered blocks (events x2, init x2, out x2) exceed the
         # 16MiB default scoped-vmem budget once n_bt > 1; v5e has 128MiB
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(presence, base2, ev5, rows5)
@@ -794,6 +799,131 @@ def replay_scan_pallas_teb(
                                ablate, presence, base,
                                wide_cols=tuple(wide_cols))
     return rows_to_state(rows[:, :B], rm)
+
+
+def replay_scan_pallas_packed(
+    state: S.StateTensors,
+    out0: S.StateTensors,
+    events_teb,
+    seg_end,
+    out_row,
+    caps: S.Capacities,
+    tb: int = 16,
+    interpret: bool | None = None,
+    bt: int = BT,
+    base=None,
+    wide_cols: tuple = (),
+):
+    """Lane-packed replay on the Pallas kernel (mirror of
+    ops.replay.replay_scan_packed).
+
+    The VMEM-resident kernel has no cross-lane scatter, so segment
+    flush/reset happens *between* time blocks: histories must be packed
+    with ``seg_align`` a multiple of ``tb`` (pack_lanes(seg_align=tb)),
+    which pins every segment boundary to a block-final step. The scan
+    then alternates: kernel advances one tb-step block with the lane
+    tile in VMEM → XLA scatters flagged lanes' state columns into their
+    output rows and resets them to empty. Relative to the unpacked
+    kernel this flushes state per block instead of once per batch tile —
+    the price of emitting mid-scan snapshots — while the event stream
+    (the bound) is unchanged.
+
+    ``events_teb``: [T, EV_N, L]; ``seg_end``/``out_row``: [L, T];
+    ``out0``: [n_out] empty_state buffer (same contract as the XLA
+    packed scan). May be the int16 narrow stream from
+    ``narrow_events_teb`` (pass its ``base`` [EV_N] int32 and static
+    ``wide_cols``) — exact int32 reconstruction in-kernel, bit-identical
+    output, about half the event-stream bytes the kernel is bound by.
+    Returns (final_lane_state, out).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    events_teb = jnp.asarray(events_teb)
+    narrow = events_teb.dtype == jnp.int16
+    if narrow and base is None:
+        raise ValueError("int16 events need their affine base vector")
+    T, ev_n, L = events_teb.shape
+    if T % tb:
+        raise ValueError(f"packed scan length {T} not a multiple of tb={tb}")
+    try:  # concrete inputs only — tracers skip the host-side check
+        seg_np = np.asarray(seg_end)
+    except Exception:
+        seg_np = None
+    if seg_np is not None:
+        interior = seg_np.reshape(L, T // tb, tb)[:, :, : tb - 1]
+        if interior.any():
+            raise ValueError(
+                "segment boundaries must be tb-aligned for the Pallas "
+                "packed path — pack with pack_lanes(seg_align=tb)"
+            )
+    rm = RowMap(caps)
+    b_pad = (-L) % bt
+    if b_pad:
+        if narrow:
+            # padding must reconstruct EV_TYPE == -1 through the base
+            # (same trick as replay_scan_pallas_teb)
+            phys, _ = _phys_map(wide_cols)
+            pad_type = jnp.int16(-1 - int(np.asarray(base)[S.EV_TYPE]))
+            fill = jnp.zeros((T, ev_n, L + b_pad), jnp.int16)
+            fill = fill.at[:, phys[S.EV_TYPE], :].set(pad_type)
+        else:
+            fill = jnp.zeros((T, ev_n, L + b_pad), jnp.int32)
+            fill = fill.at[:, S.EV_TYPE, :].set(-1)
+        events_teb = fill.at[:, :, :L].set(events_teb)
+        pad_state = jax.tree_util.tree_map(
+            jnp.asarray, S.empty_state(b_pad, caps)
+        )
+        rows0 = jnp.concatenate(
+            [state_to_rows(state, rm), state_to_rows(pad_state, rm)], axis=1
+        )
+        seg_end = jnp.concatenate(
+            [jnp.asarray(seg_end),
+             jnp.zeros((b_pad, T), dtype=jnp.asarray(seg_end).dtype)],
+            axis=0,
+        )
+        out_row = jnp.concatenate(
+            [jnp.asarray(out_row), jnp.zeros((b_pad, T), jnp.int32)], axis=0
+        )
+    else:
+        rows0 = state_to_rows(state, rm)
+    lb = L + b_pad
+    n_out = out0.exec_info.shape[0]
+    out_rows0 = state_to_rows(out0, rm)
+    empty_col = state_to_rows(
+        jax.tree_util.tree_map(jnp.asarray, S.empty_state(1, caps)), rm
+    )
+    nb = T // tb
+    ev_blocks = events_teb.reshape(nb, tb, ev_n, lb)
+    seg_b = jnp.transpose(jnp.asarray(seg_end)[:, tb - 1 :: tb])  # [nb, lb]
+    row_b = jnp.transpose(jnp.asarray(out_row)[:, tb - 1 :: tb])
+
+    def body(carry, xs):
+        rows, out = carry
+        evb, seg, orow = xs
+        rows = _replay_rows_pallas(
+            evb, rows, caps, tb, interpret, bt, base=base,
+            wide_cols=tuple(wide_cols),
+        )
+
+        def flush(args):
+            rows, out = args
+            idx = jnp.where(seg, orow, n_out)
+            out = out.at[:, idx].set(rows, mode="drop")
+            rows = jnp.where(seg[None, :], empty_col, rows)
+            return rows, out
+
+        rows, out = lax.cond(
+            jnp.any(seg), flush, lambda args: args, (rows, out)
+        )
+        return (rows, out), None
+
+    (rows, out), _ = jax.lax.scan(
+        body, (rows0, out_rows0), (ev_blocks, seg_b, row_b)
+    )
+    return (
+        rows_to_state(rows[:, :L], rm),
+        rows_to_state(out, rm),
+    )
 
 
 def replay_scan_pallas(

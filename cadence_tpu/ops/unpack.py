@@ -256,6 +256,33 @@ def mutable_state_to_snapshot(ms: MutableState) -> Dict[str, Any]:
     return snap
 
 
+def split_lane_snapshots(packed, final: S.StateTensors) -> list:
+    """Split a lane-packed replay's output back into per-history
+    snapshots, in the packer's input order.
+
+    ``packed``: the :class:`~cadence_tpu.ops.pack.PackedLanes` whose
+    lanes were replayed; ``final``: the output StateTensors from
+    ``replay_packed_lanes``/``replay_scan_packed`` (one row per
+    history). The per-lane segment side tables are the source of truth
+    for which output row belongs to which history — this walks them
+    (rather than trusting row order) so a mis-scattered row surfaces as
+    a snapshot mismatch, not silent misattribution.
+    """
+    n = packed.n_histories
+    snaps = [None] * n
+    for segs in packed.lane_segments:
+        for out_row, _start, _end in segs:
+            snaps[out_row] = state_row_to_snapshot(
+                final, out_row, packed.epoch_s
+            )
+    missing = [i for i in range(n) if snaps[i] is None]
+    if missing:
+        raise ValueError(
+            f"lane segment tables miss output rows {missing[:8]}"
+        )
+    return snaps
+
+
 def state_row_to_mutable_state(
     state: S.StateTensors, b: int, side: WorkflowSideTable,
     domain_id: str = "",

@@ -24,6 +24,20 @@ SHARD_AXIS = "shard"
 SEQ_AXIS = "seq"
 
 
+# shard_map moved out of jax.experimental, and its replication-check
+# kwarg was renamed check_rep -> check_vma, across jax releases; this
+# shim presents the new-style surface on either.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(*args, **kwargs)
+
+
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     seq: int = 1,

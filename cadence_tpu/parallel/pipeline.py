@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from .mesh import shard_map
 
 from cadence_tpu.ops import schema as S
 from cadence_tpu.ops.replay import replay_scan

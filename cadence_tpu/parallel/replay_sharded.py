@@ -24,14 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-shard_map = jax.shard_map
-
 from cadence_tpu.ops import schema as S
 from cadence_tpu.ops.pack import PackedHistories
 from cadence_tpu.ops.refresh import RefreshedTasks, refresh_tasks_device
 from cadence_tpu.ops.replay import replay_scan
 
-from .mesh import SHARD_AXIS, events_spec, shard_spec
+from .mesh import SHARD_AXIS, events_spec, shard_map, shard_spec
 
 
 def _state_specs(sharding: NamedSharding) -> S.StateTensors:

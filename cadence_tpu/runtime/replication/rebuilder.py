@@ -48,13 +48,17 @@ class RebuildRequest:
 class StateRebuilder:
     def __init__(self, history: HistoryManager,
                  domain_resolver=lambda name: name,
-                 chunk_size=0) -> None:
+                 chunk_size=0, lane_len: int = 1024) -> None:
         self.history = history
         self.domain_resolver = domain_resolver
         # device-dispatch chunk for rebuild_many: an int, or a callable
         # re-read every resolve (dynamicconfig history.rebuildChunkSize
         # via bootstrap stays live-tunable); 0 = backend default
         self.chunk_size = chunk_size
+        # lane capacity (events) for ragged lane packing in
+        # rebuild_many: shallow histories pack back-to-back into lanes
+        # of this length instead of each padding a lane to max(depth)
+        self.lane_len = lane_len
         self._backend_chunk = 0
 
     def _resolve_chunk(self) -> int:
@@ -138,35 +142,44 @@ class StateRebuilder:
             from cadence_tpu.ops.dispatch import (
                 DeviceDispatcher,
                 DispatchError,
+                depth_buckets,
             )
             from cadence_tpu.ops.unpack import state_row_to_mutable_state
         except Exception:  # jax unavailable — host path
             return [self.rebuild(r) for r in reqs]
 
-        # storm drain: chunk the stream through the double-buffered
-        # host→device dispatcher (ops/dispatch.py) so packing batch k+1
-        # overlaps replaying batch k; each failed chunk (capacity
-        # overflow etc.) falls back per-workflow to the host oracle
+        # storm drain: depth-bucket the stream (a few deep stragglers
+        # must not stretch every lane), lane-pack each bucket (several
+        # whole histories per scan lane), and pump the chunks through
+        # the double-buffered host→device dispatcher (ops/dispatch.py)
+        # so packing batch k+1 overlaps replaying batch k; each failed
+        # chunk (capacity overflow etc.) falls back per-workflow to the
+        # host oracle
         chunk = self._resolve_chunk()
-        out: List[Tuple[MutableState, list, list]] = []
-        d = DeviceDispatcher(domain_resolver=self.domain_resolver)
-        for i in range(0, len(reqs), chunk):
-            d.submit(i, histories[i : i + chunk])
+        out: List[Optional[Tuple[MutableState, list, list]]] = (
+            [None] * len(reqs)
+        )
+        d = DeviceDispatcher(
+            domain_resolver=self.domain_resolver, lane_pack=True,
+            lane_len=self.lane_len,
+        )
+        for idxs, hs in depth_buckets(histories):
+            for j in range(0, len(hs), chunk):
+                d.submit(idxs[j : j + chunk], hs[j : j + chunk])
         d.finish()
         for item in d.results(strict=False):
             if isinstance(item, DispatchError):
-                i0 = item.batch_id
-                out.extend(
-                    self.rebuild(r) for r in reqs[i0 : i0 + chunk]
-                )
+                for gi in item.batch_id:
+                    out[gi] = self.rebuild(reqs[gi])
                 continue
-            i0, packed, final = item
-            for j, r in enumerate(reqs[i0 : i0 + chunk]):
+            idxs, packed, final = item
+            for j, gi in enumerate(idxs):
+                r = reqs[gi]
                 ms = state_row_to_mutable_state(
                     final, j, packed.side[j],
                     domain_id=r.domain_id, epoch_s=packed.epoch_s,
                 )
                 ms.execution_info.branch_token = r.branch_token
                 transfer, timer = refresh_tasks(ms)
-                out.append((ms, transfer, timer))
+                out[gi] = (ms, transfer, timer)
         return out

@@ -33,6 +33,24 @@ def test_smoke_emits_one_json_record():
     head = out["configs"]["retry_deep"]
     assert head["histories_per_sec"] > 0
     assert head["baseline_cpp_per_sec"] > 0
+    # the lane-packing contract: every config reports its padding waste,
+    # and packed configs keep it < 1.0 (padded steps < real events) —
+    # a packer regression (fragmenting lanes, over-rounding) fails here
+    packed_seen = 0
+    for name, cfg in out["configs"].items():
+        if "histories_per_sec" not in cfg:
+            continue
+        assert "padding_frac" in cfg, f"{name} lacks padding_frac"
+        assert "lanes_per_history" in cfg, f"{name} lacks lanes_per_history"
+        if cfg.get("packed"):
+            packed_seen += 1
+            assert cfg["padding_frac"] < 1.0, (name, cfg["padding_frac"])
+            assert 0 < cfg["lanes_per_history"] < 1.0, name
+            # the waste the packer removes must be visible in-record
+            # (throughput ratios are host-load noise at smoke scale, so
+            # only the padding contract is asserted)
+            assert cfg["unpacked_padding_frac"] > cfg["padding_frac"], name
+    assert packed_seen >= 1, "smoke must cover a lane-packed config"
 
 
 def test_watchdog_still_yields_parseable_record():

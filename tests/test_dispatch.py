@@ -86,6 +86,107 @@ def test_strict_results_raise():
 import pytest
 
 
+def _oneshot_snapshot(history):
+    from cadence_tpu.ops.unpack import state_row_to_snapshot
+
+    packed, final = _oneshot([history])
+    return state_row_to_snapshot(final, 0, packed.epoch_s)
+
+
+def test_bucketed_lane_packed_stream_preserves_identity_and_order():
+    """Depth-bucketed, lane-packed replay returns every history's state
+    under its original index, bit-identical to a solo replay."""
+    from cadence_tpu.ops.unpack import state_row_to_snapshot
+
+    fz = HistoryFuzzer(seed=7, caps=CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}",
+         fz.generate(target_events=10 + (i % 4) * 14))
+        for i in range(18)
+    ]
+    got = replay_stream(hs, caps=CAPS, batch_size=8, bucket=True,
+                        lane_len=128)
+    from cadence_tpu.ops.dispatch import history_depth
+    from cadence_tpu.ops.pack import round_scan_len
+
+    seen = {}
+    batch_keys = []
+    for idxs, packed, final in got:
+        # a batch never mixes depth classes
+        keys = {round_scan_len(history_depth(hs[gi][2])) for gi in idxs}
+        assert len(keys) == 1, "batch mixes depth buckets"
+        batch_keys.append(keys.pop())
+        for j, gi in enumerate(idxs):
+            assert gi not in seen, "history yielded twice"
+            seen[gi] = state_row_to_snapshot(final, j, packed.epoch_s)
+    assert sorted(seen) == list(range(len(hs)))
+    # buckets come back shallowest-first
+    assert batch_keys == sorted(batch_keys), batch_keys
+    for i, h in enumerate(hs):
+        assert seen[i] == _oneshot_snapshot(h), f"history {i} diverged"
+
+
+def test_lane_packed_dispatcher_matches_oneshot():
+    d = DeviceDispatcher(caps=CAPS, lane_pack=True, lane_len=128)
+    hs = _histories(10, seed=21)
+    d.submit("b0", hs)
+    d.finish()
+    from cadence_tpu.ops.unpack import state_row_to_snapshot
+
+    [(bid, packed, final)] = list(d.results())
+    assert bid == "b0" and packed.n_histories == 10
+    assert packed.lanes < 10  # actually packed, not one-per-lane
+    for i, h in enumerate(hs):
+        got = state_row_to_snapshot(final, i, packed.epoch_s)
+        assert got == _oneshot_snapshot(h), i
+
+
+def test_strict_results_drain_pumps_after_raise():
+    """Abandoning results() at a strict raise must not leave the pack
+    pump blocked on the bounded staged queue."""
+    d = DeviceDispatcher(caps=CAPS, depth=1)
+    d.submit("ok-0", _histories(3))
+    d.submit("boom", [("wf", "run", 42)])
+    # enough work behind the failure to fill a depth-1 staged queue
+    for i in range(6):
+        d.submit(f"tail-{i}", _histories(3, seed=10 + i))
+    d.finish()
+    it = d.results(strict=True)
+    ok = next(it)
+    assert ok[0] == "ok-0"
+    with pytest.raises(DispatchError):
+        for _ in it:
+            pass
+    # the background drain lets both pumps run to completion
+    d._packer.join(timeout=30)
+    d._runner.join(timeout=30)
+    assert not d._packer.is_alive(), "pack pump stuck after strict raise"
+    assert not d._runner.is_alive(), "run pump stuck after strict raise"
+
+
+def test_depth_buckets_geometric_grouping():
+    from cadence_tpu.ops.dispatch import depth_buckets, history_depth
+
+    fz = HistoryFuzzer(seed=13, caps=CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}",
+         fz.generate(target_events=8 if i % 3 else 48))
+        for i in range(12)
+    ]
+    buckets = depth_buckets(hs)
+    assert sum(len(idxs) for idxs, _ in buckets) == len(hs)
+    last_key = 0
+    for idxs, members in buckets:
+        from cadence_tpu.ops.pack import round_scan_len
+
+        keys = {round_scan_len(history_depth(h[2])) for h in members}
+        assert len(keys) == 1, "bucket mixes depth classes"
+        key = keys.pop()
+        assert key >= last_key, "buckets not shallowest-first"
+        last_key = key
+        assert list(idxs) == [hs.index(m) for m in members]
+
+
 @pytest.mark.slow
 def test_pallas_narrow_serving_path_interpret():
     """The dispatcher's pallas+narrow serving path end-to-end on CPU

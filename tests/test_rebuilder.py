@@ -44,6 +44,45 @@ def stored():
     bundle.close()
 
 
+def test_mixed_depth_bucketed_rebuild_matches_host():
+    """rebuild_many depth-buckets and lane-packs the stream: a mixed
+    batch (shallow echoes + deep stragglers) must come back in request
+    order, each bit-identical to the host rebuild."""
+    bundle = create_memory_bundle()
+    try:
+        history = bundle.history
+        fuzzer = HistoryFuzzer(seed=31)
+        reqs = []
+        for i in range(9):
+            depth = 150 if i % 4 == 3 else 10
+            batches = fuzzer.generate(target_events=depth)
+            branch = history.new_history_branch(tree_id=f"run-{i}")
+            txn = 1
+            for batch in batches:
+                history.append_history_nodes(
+                    branch, batch, transaction_id=txn)
+                txn += 1
+            reqs.append(RebuildRequest(
+                domain_id="dom", workflow_id=f"wf-{i}", run_id=f"run-{i}",
+                branch_token=branch.to_json().encode(),
+            ))
+        rebuilder = StateRebuilder(history, lane_len=256)
+        host = [rebuilder.rebuild(r) for r in reqs]
+        dev = rebuilder.rebuild_many(reqs, use_device=True)
+        assert len(dev) == len(reqs)
+        for (h_ms, h_tr, h_ti), (d_ms, d_tr, d_ti) in zip(host, dev):
+            assert h_ms.execution_info.workflow_id == \
+                d_ms.execution_info.workflow_id, "result order broken"
+            assert mutable_state_to_snapshot(h_ms) == \
+                mutable_state_to_snapshot(d_ms)
+            assert [t.task_type for t in h_tr] == [
+                t.task_type for t in d_tr]
+            assert [(t.task_type, t.visibility_timestamp) for t in h_ti] \
+                == [(t.task_type, t.visibility_timestamp) for t in d_ti]
+    finally:
+        bundle.close()
+
+
 def test_device_batch_rebuild_matches_host(stored):
     history, reqs = stored
     rebuilder = StateRebuilder(history)

@@ -5,6 +5,7 @@ identical canonical snapshot to replaying the same batches through
 ``StateBuilder.apply_events`` host-side.
 """
 
+import numpy as np
 import pytest
 
 from cadence_tpu.core import history_factory as F
@@ -13,9 +14,19 @@ from cadence_tpu.core.mutable_state import MutableState, SECOND
 from cadence_tpu.core.state_builder import StateBuilder
 from cadence_tpu.core.version_history import VersionHistories
 from cadence_tpu.ops import schema as S
-from cadence_tpu.ops.pack import PackOverflowError, pack_histories, pack_workflow
-from cadence_tpu.ops.replay import replay_packed
-from cadence_tpu.ops.unpack import mutable_state_to_snapshot, state_row_to_snapshot
+from cadence_tpu.ops.pack import (
+    PackOverflowError,
+    pack_histories,
+    pack_lanes,
+    pack_workflow,
+    round_scan_len,
+)
+from cadence_tpu.ops.replay import replay_packed, type_signature
+from cadence_tpu.ops.unpack import (
+    mutable_state_to_snapshot,
+    split_lane_snapshots,
+    state_row_to_snapshot,
+)
 
 T0 = 1_700_000_000 * SECOND
 V = 10
@@ -260,6 +271,117 @@ class TestKernelOracleParity:
         pad = state_row_to_snapshot(final, 7, packed.epoch_s)
         assert pad["activities"] == {} and pad["version_history"] == []
         assert pad["exec"]["state"] == 0
+
+
+class TestLanePacking:
+    """Ragged lane packing (ops/pack.pack_lanes): K whole histories
+    back-to-back per scan lane must be byte-identical to replaying each
+    history in its own lane, and to the host oracle."""
+
+    CAPS = S.Capacities(max_events=64)
+
+    def _fuzz(self, n, seed=11):
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        fz = HistoryFuzzer(seed=seed, caps=self.CAPS)
+        return [
+            (f"wf-{i}", f"run-{i}",
+             fz.generate(target_events=6 + (i * 7) % 40))
+            for i in range(n)
+        ]
+
+    @pytest.mark.parametrize("seg_align", [1, 8])
+    def test_fuzzed_lane_packed_matches_unpacked_and_oracle(self, seg_align):
+        hs = self._fuzz(17)
+        lanes = pack_lanes(
+            hs, caps=self.CAPS, target_lane_len=96, seg_align=seg_align
+        )
+        assert lanes.lanes < len(hs), "packer must share lanes"
+        final = replay_packed(lanes)
+
+        ref = replay_packed(pack_histories(hs, caps=self.CAPS))
+        # byte identity, field for field, history for history
+        for name in ("exec_info", "activities", "timers", "children",
+                     "cancels", "signals", "vh_items", "vh_len"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(final, name))[: len(hs)],
+                np.asarray(getattr(ref, name))[: len(hs)],
+                err_msg=f"lane-packed {name} != per-lane replay "
+                        f"(seg_align={seg_align})",
+            )
+        # and the host oracle, via the lane segment side tables
+        snaps = split_lane_snapshots(lanes, final)
+        for i, (wf, run, batches) in enumerate(hs):
+            oracle = mutable_state_to_snapshot(
+                oracle_replay(batches, workflow_id=wf, run_id=run)
+            )
+            assert snaps[i] == oracle, f"history {i} diverged from oracle"
+
+    def test_scenarios_lane_packed(self):
+        hs = [
+            (f"wf-{i}", f"run-{i}", fn())
+            for i, fn in enumerate(ALL_SCENARIOS)
+        ]
+        lanes = pack_lanes(hs, target_lane_len=128)
+        final = replay_packed(lanes)
+        for i, (wf, run, batches) in enumerate(hs):
+            got = state_row_to_snapshot(final, i, lanes.epoch_s)
+            want = mutable_state_to_snapshot(
+                oracle_replay(batches, workflow_id=wf, run_id=run)
+            )
+            assert got == want, ALL_SCENARIOS[i].__name__
+
+    def test_type_specialized_scan_is_bit_identical(self):
+        """The static type-set specialization must not change results."""
+        from cadence_tpu.ops.replay import replay_packed_lanes
+
+        hs = self._fuzz(9, seed=4)
+        lanes = pack_lanes(hs, caps=self.CAPS, target_lane_len=96)
+        spec = replay_packed_lanes(lanes, specialize=True)
+        full = replay_packed_lanes(lanes, specialize=False)
+        for name in ("exec_info", "activities", "timers", "children",
+                     "cancels", "signals", "vh_items", "vh_len"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(spec, name)),
+                np.asarray(getattr(full, name)),
+                err_msg=f"type specialization changed {name}",
+            )
+        # the signature covers every present type that drives a
+        # transition block (pass-through types — markers, upserts — have
+        # no block to gate and may drop out)
+        from cadence_tpu.ops.replay import _type_groups
+
+        grouped = {int(t) for g in _type_groups() for t in g}
+        sig = set(type_signature(lanes.present_types))
+        assert (set(lanes.present_types) & grouped) <= sig
+
+    def test_one_history_per_lane_fallback(self):
+        """When no two histories fit a lane (target below any pair sum),
+        packing degenerates to pack_histories density: one history per
+        lane — the lane capacity never stretches past the longest
+        single history."""
+        hs = [
+            (f"wf-{i}", f"run-{i}", timer_batches())
+            for i in range(5)
+        ]
+        lanes = pack_lanes(hs, caps=self.CAPS, target_lane_len=1)
+        assert lanes.n_histories == 5
+        assert all(len(segs) <= 1 for segs in lanes.lane_segments)
+        final = replay_packed(lanes)
+        for i, (wf, run, batches) in enumerate(hs):
+            got = state_row_to_snapshot(final, i, lanes.epoch_s)
+            want = mutable_state_to_snapshot(
+                oracle_replay(batches, workflow_id=wf, run_id=run)
+            )
+            assert got == want
+
+    def test_round_scan_len_grid(self):
+        assert [round_scan_len(n) for n in (1, 8, 9, 13, 17, 25, 769, 1000)] \
+            == [8, 8, 12, 16, 24, 32, 1024, 1024]
+        # monotone, bounded overhead (adjacent grid ratio ≤ 1.5)
+        for n in range(1, 3000, 37):
+            g = round_scan_len(n)
+            assert g >= n and (n <= 8 or g < n * 1.5)
 
 
 class TestPackValidation:

@@ -102,6 +102,133 @@ def test_rowmap_roundtrip():
     _assert_state_equal(back, final)
 
 
+def test_packed_lanes_parity_fast():
+    """Chunked Pallas packed path (replay_scan_pallas_packed) ==
+    XLA packed scan, bit for bit, on a tiny tb-aligned packing."""
+    from cadence_tpu.ops.pack import pack_lanes, round_scan_len
+    from cadence_tpu.ops.replay import replay_packed_lanes
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas_packed
+
+    tb = 8
+    fz = HistoryFuzzer(seed=6, caps=FAST_CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}", fz.generate(target_events=8))
+        for i in range(4)
+    ]
+    lanes = pack_lanes(hs, caps=FAST_CAPS, target_lane_len=16, seg_align=tb)
+    want = replay_packed_lanes(lanes)  # XLA packed path (numpy out)
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(lanes.lanes, FAST_CAPS)
+    )
+    out0 = jax.tree_util.tree_map(
+        jnp.asarray,
+        S.empty_state(round_scan_len(lanes.n_histories), FAST_CAPS),
+    )
+    _, got = replay_scan_pallas_packed(
+        state0, out0, jnp.asarray(lanes.teb()),
+        jnp.asarray(lanes.seg_end), jnp.asarray(lanes.out_row),
+        FAST_CAPS, tb=tb, interpret=True, bt=1024,
+    )
+    got = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[: lanes.n_histories], got
+    )
+    _assert_state_equal(got, want)
+
+
+def test_packed_lanes_rejects_misaligned_segments():
+    from cadence_tpu.ops.pack import pack_lanes
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas_packed
+
+    fz = HistoryFuzzer(seed=6, caps=FAST_CAPS)
+    hs = [(f"wf-{i}", f"run-{i}", fz.generate(target_events=9))
+          for i in range(3)]
+    lanes = pack_lanes(hs, caps=FAST_CAPS, target_lane_len=24, seg_align=1)
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(lanes.lanes, FAST_CAPS)
+    )
+    out0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(8, FAST_CAPS)
+    )
+    with pytest.raises(ValueError, match="tb-aligned"):
+        replay_scan_pallas_packed(
+            state0, out0, jnp.asarray(lanes.teb()),
+            jnp.asarray(lanes.seg_end), jnp.asarray(lanes.out_row),
+            FAST_CAPS, tb=8, interpret=True, bt=1024,
+        )
+
+
+def test_packed_lanes_narrow_int16_parity():
+    """Packed + int16 narrow stream == packed int32, bit for bit."""
+    from cadence_tpu.ops.pack import pack_lanes, round_scan_len
+    from cadence_tpu.ops.replay_pallas import (
+        narrow_events_teb,
+        replay_scan_pallas_packed,
+    )
+
+    tb = 8
+    fz = HistoryFuzzer(seed=14, caps=FAST_CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}", fz.generate(target_events=8))
+        for i in range(4)
+    ]
+    lanes = pack_lanes(hs, caps=FAST_CAPS, target_lane_len=16, seg_align=tb)
+    narrowed = narrow_events_teb(lanes.teb())
+    assert narrowed is not None, "fuzzed batch should narrow"
+    ev16, base, wide = narrowed
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(lanes.lanes, FAST_CAPS)
+    )
+    out0 = jax.tree_util.tree_map(
+        jnp.asarray,
+        S.empty_state(round_scan_len(lanes.n_histories), FAST_CAPS),
+    )
+    args = (jnp.asarray(lanes.seg_end), jnp.asarray(lanes.out_row))
+    _, want = replay_scan_pallas_packed(
+        state0, out0, jnp.asarray(lanes.teb()), *args,
+        FAST_CAPS, tb=tb, interpret=True, bt=1024,
+    )
+    _, got = replay_scan_pallas_packed(
+        state0, out0, jnp.asarray(ev16), *args,
+        FAST_CAPS, tb=tb, interpret=True, bt=1024,
+        base=base, wide_cols=wide,
+    )
+    _assert_state_equal(got, want)
+
+
+@slow
+def test_packed_lanes_parity_fuzzed():
+    """Wider fuzzed packing through the chunked Pallas packed path."""
+    from cadence_tpu.ops.pack import pack_lanes, round_scan_len
+    from cadence_tpu.ops.replay import replay_scan_packed, type_signature
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas_packed
+
+    tb = 8
+    fz = HistoryFuzzer(seed=19, caps=CAPS)
+    hs = [
+        (f"wf-{i}", f"run-{i}", fz.generate(target_events=10 + (i * 9) % 30))
+        for i in range(11)
+    ]
+    lanes = pack_lanes(hs, caps=CAPS, target_lane_len=64, seg_align=tb)
+    state0 = jax.tree_util.tree_map(
+        jnp.asarray, S.empty_state(lanes.lanes, CAPS)
+    )
+    out0 = jax.tree_util.tree_map(
+        jnp.asarray,
+        S.empty_state(round_scan_len(lanes.n_histories), CAPS),
+    )
+    ev_tm, seg_tm, row_tm = lanes.time_major()
+    _, want = replay_scan_packed(
+        state0, out0, jnp.asarray(ev_tm), jnp.asarray(seg_tm),
+        jnp.asarray(row_tm), types=type_signature(lanes.present_types),
+    )
+    _, got = replay_scan_pallas_packed(
+        state0, out0, jnp.asarray(lanes.teb()),
+        jnp.asarray(lanes.seg_end), jnp.asarray(lanes.out_row),
+        CAPS, tb=tb, interpret=True, bt=1024,
+    )
+    _assert_state_equal(got, want)
+
+
 @slow
 def test_parity_echo():
     _parity([(f"wf-{i}", f"run-{i}", W.echo_history()) for i in range(7)])
