@@ -1,0 +1,54 @@
+"""Checkpointed incremental replay: device-resumable state snapshots.
+
+Replay cost without this package is strictly proportional to full
+history depth — every rebuild starts from ``empty_state`` and replays
+from event 1. This package persists periodic per-run state snapshots
+(the replay kernel's carry at a transaction-batch boundary, plus the
+packer continuation needed to keep slot assignment deterministic) so a
+rebuild replays only the event SUFFIX past the nearest durable
+snapshot: repeat-rebuild cost becomes O(new events), the snapshot+
+suffix state-transfer move of replicated state machines
+(arXiv:2110.04448) applied to the accelerator scan (the cached-carry
+continuation of arXiv:2603.09555).
+
+Pieces:
+
+* :mod:`record` — the durable :class:`ReplayCheckpoint` (state row +
+  pack resume + side table + version-history stamp), serde via the
+  persistence JSON codecs;
+* :mod:`fingerprint` — the transition-function fingerprint stamped on
+  every record, so a kernel/schema change invalidates stale carries
+  instead of silently resuming on different semantics;
+* :mod:`store` — the :class:`CheckpointStore` contract with in-memory
+  and sqlite backends (a member of ``PersistenceBundle``, so
+  ``wrap_bundle(faults=...)`` puts chaos rules on checkpoint I/O);
+* :mod:`manager` — lookup (fingerprint + capacity + NDC-LCA
+  validation), write policy (every N events), retention (keep last K
+  per run tree), and the conversions to/from the packer's resume
+  states. Every store interaction is failure-isolated: a broken
+  checkpoint plane degrades to full replay, never to a wrong rebuild.
+"""
+
+from .fingerprint import transition_fingerprint
+from .manager import (
+    CheckpointManager,
+    CheckpointPolicy,
+    checkpoint_from_replay,
+)
+from .record import ReplayCheckpoint
+from .store import (
+    CheckpointStore,
+    MemoryCheckpointStore,
+    SqliteCheckpointStore,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "CheckpointPolicy",
+    "CheckpointStore",
+    "MemoryCheckpointStore",
+    "ReplayCheckpoint",
+    "SqliteCheckpointStore",
+    "checkpoint_from_replay",
+    "transition_fingerprint",
+]

@@ -41,6 +41,8 @@ class RunningServer:
     # faults_injected + the injected-error counters for that run
     faults: object = None
     metrics: object = None
+    # CheckpointManager when the checkpoint section is enabled
+    checkpoints: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
@@ -129,6 +131,14 @@ def start_services(
         faults = cfg.chaos.build_schedule(metrics=metrics)
         persistence = wrap_bundle(persistence, metrics=metrics, faults=faults)
 
+    # checkpoint section: incremental-replay snapshots over the
+    # bundle's checkpoint store. Built AFTER the chaos wrap, so a
+    # chaos config's persistence.checkpoint rules fault-inject every
+    # snapshot read/write this host performs (fallback: full replay)
+    checkpoints = cfg.checkpoint.build_manager(
+        store=getattr(persistence, "checkpoint", None)
+    )
+
     domains = DomainCache(persistence.metadata)
     cluster_metadata = cfg.build_cluster_metadata()
 
@@ -180,6 +190,7 @@ def start_services(
         bus=MessageBus() if "worker" in services else None,
         faults=faults,
         metrics=metrics,
+        checkpoints=checkpoints,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
     # first configured service's port wins, bound on that service's
@@ -219,6 +230,7 @@ def start_services(
             ),
             faults=faults,
             metrics=metrics,
+            checkpoints=checkpoints,
         )
         out.history = history
 

@@ -133,6 +133,55 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass
+class CheckpointConfig:
+    """Checkpointed incremental replay (cadence_tpu/checkpoint/).
+
+    When enabled, every history shard's state rebuilder resumes replays
+    from the nearest durable snapshot and writes fresh ones —
+    ``everyEvents`` sets the snapshot cadence (a new one only when the
+    run tip advanced that many events), ``keepLast`` the per-run-tree
+    retention. The store rides the persistence bundle (memory or
+    sqlite, matching the configured datastore), so chaos rules on
+    ``persistence.checkpoint`` exercise the full-replay fallback. OFF
+    by default: a disabled section builds nothing."""
+
+    enabled: bool = False
+    every_events: int = 256
+    keep_last: int = 2
+
+    def validate(self) -> None:
+        try:
+            self._policy()
+        except ValueError as e:
+            raise ConfigError(f"checkpoint: {e}")
+
+    def _policy(self):
+        from cadence_tpu.checkpoint import CheckpointPolicy
+
+        policy = CheckpointPolicy(
+            every_events=self.every_events, keep_last=self.keep_last
+        )
+        policy.validate()
+        return policy
+
+    def build_manager(self, store=None):
+        """The CheckpointManager this section describes, or None when
+        disabled. ``store``: the persistence bundle's checkpoint store
+        (falls back to a fresh in-memory store)."""
+        if not self.enabled:
+            return None
+        from cadence_tpu.checkpoint import (
+            CheckpointManager,
+            MemoryCheckpointStore,
+        )
+
+        return CheckpointManager(
+            store if store is not None else MemoryCheckpointStore(),
+            policy=self._policy(),
+        )
+
+
+@dataclasses.dataclass
 class ServerConfig:
     persistence: PersistenceConfig = dataclasses.field(
         default_factory=PersistenceConfig
@@ -143,6 +192,9 @@ class ServerConfig:
     ring: RingConfig = dataclasses.field(default_factory=RingConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
     dynamicconfig_path: str = ""
     archival_dir: str = ""
 
@@ -150,6 +202,7 @@ class ServerConfig:
         self.persistence.validate()
         self.cluster.validate()
         self.chaos.validate()
+        self.checkpoint.validate()
         for name in self.services:
             if name not in SERVICES:
                 raise ConfigError(f"services: unknown service '{name}'")
@@ -246,6 +299,14 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "seed": "seed",
             "rules": "rules",
         }, "chaos"))
+
+    ckpt = raw.pop("checkpoint", None)
+    if ckpt:
+        cfg.checkpoint = CheckpointConfig(**_take(ckpt, {
+            "enabled": "enabled",
+            "everyEvents": "every_events",
+            "keepLast": "keep_last",
+        }, "checkpoint"))
 
     dc = raw.pop("dynamicConfig", None)
     if dc:

@@ -148,13 +148,21 @@ class DeviceDispatcher:
 
     # -- producer side --------------------------------------------------
 
-    def submit(self, batch_id, histories: Sequence[Tuple]) -> None:
-        """Enqueue one batch of (workflow_id, run_id, event_batches)."""
+    def submit(
+        self, batch_id, histories: Sequence[Tuple], resume=None,
+    ) -> None:
+        """Enqueue one batch of (workflow_id, run_id, event_batches).
+
+        ``resume``: optional per-history sequence of
+        Optional[ops.pack.ResumeState] — resumed histories' events are
+        their SUFFIX from the snapshot; the packed scan seeds their
+        segment carries from the snapshot rows (checkpointed
+        incremental replay)."""
         if not self._started:
             self._packer.start()
             self._runner.start()
             self._started = True
-        self._in.put((batch_id, histories))
+        self._in.put((batch_id, histories, resume))
 
     def finish(self) -> None:
         """No more submits; results() ends after the queued work.
@@ -188,15 +196,17 @@ class DeviceDispatcher:
             if item is None:
                 self._staged.put(None)
                 return
-            batch_id, histories = item
+            batch_id, histories, resume = item
             try:
                 if self.lane_pack:
                     staged = self._pack_lanes_item(
-                        batch_id, histories, use_pallas, jax, jnp
+                        batch_id, histories, use_pallas, jax, jnp,
+                        resume=resume,
                     )
                 else:
                     staged = self._pack_hist_item(
-                        batch_id, histories, use_pallas, jax, jnp
+                        batch_id, histories, use_pallas, jax, jnp,
+                        resume=resume,
                     )
                 # blocks when `depth` batches are already staged — the
                 # double-buffer backpressure
@@ -204,7 +214,8 @@ class DeviceDispatcher:
             except Exception as e:
                 self._staged.put(DispatchError(batch_id, e))
 
-    def _pack_hist_item(self, batch_id, histories, use_pallas, jax, jnp):
+    def _pack_hist_item(self, batch_id, histories, use_pallas, jax, jnp,
+                        resume=None):
         from .pack import pack_histories
 
         b = len(histories)
@@ -213,6 +224,7 @@ class DeviceDispatcher:
         packed = pack_histories(
             histories, caps=self.caps, pad_batch_to=round_scan_len(b),
             domain_resolver=self.domain_resolver,
+            resume=resume,
         )
         narrow_meta = None
         if use_pallas:
@@ -233,12 +245,17 @@ class DeviceDispatcher:
                 events = jax.device_put(jnp.asarray(teb))
         else:
             events = jax.device_put(jnp.asarray(packed.time_major()))
+        # checkpoint resume seeds the initial carries; padding rows of
+        # packed.initial are empty_state, so the grid pad is unchanged
         state0 = jax.tree_util.tree_map(
-            jnp.asarray, S.empty_state(packed.batch, self.caps)
+            jnp.asarray,
+            packed.initial if packed.initial is not None
+            else S.empty_state(packed.batch, self.caps),
         )
         return ("hist", batch_id, packed, events, narrow_meta, state0, b)
 
-    def _pack_lanes_item(self, batch_id, histories, use_pallas, jax, jnp):
+    def _pack_lanes_item(self, batch_id, histories, use_pallas, jax, jnp,
+                         resume=None):
         from .pack import pack_lanes
         from .replay import type_signature
 
@@ -246,6 +263,7 @@ class DeviceDispatcher:
             histories, caps=self.caps, target_lane_len=self.lane_len,
             seg_align=self.tb if use_pallas else 1,
             domain_resolver=self.domain_resolver,
+            resume=resume,
         )
         self._type_set.update(packed.present_types)
         sig = type_signature(self._type_set)
@@ -278,16 +296,29 @@ class DeviceDispatcher:
                 jnp.asarray(seg_tm),
                 jnp.asarray(row_tm),
             )
+        # checkpoint resume: lanes whose first segment resumes seed from
+        # the snapshot row; segment-end resets gather the NEXT segment's
+        # initial row via the reset table (ops/replay.replay_scan_packed)
         state0 = jax.tree_util.tree_map(
-            jnp.asarray, S.empty_state(packed.lanes, self.caps)
+            jnp.asarray, packed.lane_state0()
         )
+        resume_extra = None
+        if packed.initial is not None:
+            import numpy as _np
+
+            reset = packed.reset_rows()                       # [L, T]
+            resume_extra = (
+                jax.tree_util.tree_map(jnp.asarray, packed.initial),
+                jnp.asarray(reset),
+                jnp.asarray(_np.ascontiguousarray(reset.T)),  # [T, L]
+            )
         out0 = jax.tree_util.tree_map(
             jnp.asarray,
             S.empty_state(round_scan_len(packed.n_histories), self.caps),
         )
         return (
             "lanes", batch_id, packed, arrays, state0, out0, sig,
-            narrow_meta,
+            narrow_meta, resume_extra,
         )
 
     def _run_pump(self) -> None:
@@ -304,7 +335,7 @@ class DeviceDispatcher:
             try:
                 if mode == "lanes":
                     (_, _, packed, arrays, state0, out0, sig,
-                     narrow_meta) = item
+                     narrow_meta, resume_extra) = item
                     if use_pallas:
                         from .replay_pallas import replay_scan_pallas_packed
 
@@ -312,16 +343,24 @@ class DeviceDispatcher:
                             narrow_meta if narrow_meta is not None
                             else (None, ())
                         )
+                        kw = {}
+                        if resume_extra is not None:
+                            kw = dict(init=resume_extra[0],
+                                      reset_row=resume_extra[1])
                         _, final = replay_scan_pallas_packed(
                             state0, out0, *arrays, self.caps,
                             tb=self.tb, bt=self.bt, base=nbase,
-                            wide_cols=nwide,
+                            wide_cols=nwide, **kw,
                         )
                     else:
                         from .replay import replay_scan_packed_jit
 
+                        kw = {}
+                        if resume_extra is not None:
+                            kw = dict(init=resume_extra[0],
+                                      reset_row_tm=resume_extra[2])
                         _, final = replay_scan_packed_jit(
-                            state0, out0, *arrays, types=sig
+                            state0, out0, *arrays, types=sig, **kw
                         )
                     import jax
 
@@ -436,6 +475,7 @@ def replay_stream(
     lane_pack: bool = False,
     lane_len: Optional[int] = None,
     bucket: bool = False,
+    resume: Optional[Sequence] = None,
 ) -> List[Tuple]:
     """Replay a large history stream through the pipelined dispatcher.
 
@@ -448,8 +488,17 @@ def replay_stream(
     lane to the deepest straggler; the return value then carries the
     original indices per batch: [(indices, packed, final_state), ...]
     where row j of ``final_state`` is history ``indices[j]``.
+
+    ``resume``: optional per-history Optional[ops.pack.ResumeState]
+    aligned with ``histories`` — resumed entries carry their event
+    SUFFIX and replay from the snapshot row (checkpointed incremental
+    replay); a resumed run buckets by its suffix depth.
     """
     out: List[Tuple] = []
+    resume = list(resume) if resume is not None else [None] * len(histories)
+    if len(resume) != len(histories):
+        raise ValueError("resume list must align with histories")
+    any_resume = any(r is not None for r in resume)
     if bucket:
         d = DeviceDispatcher(
             caps=caps, depth=depth, kernel=kernel, lane_pack=True,
@@ -458,7 +507,11 @@ def replay_stream(
         n = 0
         for idxs, hs in depth_buckets(histories):
             for j in range(0, len(hs), batch_size):
-                d.submit(idxs[j : j + batch_size], hs[j : j + batch_size])
+                sub = idxs[j : j + batch_size]
+                d.submit(
+                    sub, hs[j : j + batch_size],
+                    resume=[resume[i] for i in sub] if any_resume else None,
+                )
                 n += 1
         if n == 0:
             return out
@@ -472,7 +525,12 @@ def replay_stream(
     )
     n = 0
     for i in range(0, len(histories), batch_size):
-        d.submit(i, histories[i : i + batch_size])
+        d.submit(
+            i, histories[i : i + batch_size],
+            resume=(
+                resume[i : i + batch_size] if any_resume else None
+            ),
+        )
         n += 1
     if n == 0:
         return out

@@ -69,6 +69,69 @@ class PackOverflowError(PackError):
 
 
 @dataclasses.dataclass
+class PackResume:
+    """Packer continuation state at a history cut point.
+
+    Everything ``pack_workflow`` tracks host-side while walking a
+    history — slot assignments, the live decision, version bookkeeping —
+    captured so packing can continue from an event suffix exactly as if
+    the whole history had been packed in one call. Stored alongside the
+    device state row by the checkpoint subsystem
+    (cadence_tpu/checkpoint/); attached to every
+    :class:`WorkflowSideTable` as ``side.resume`` after packing.
+    """
+
+    next_event_id: int = 0
+    last_version: Optional[int] = None
+    version_changes: int = 0
+    pending_dec: Optional[int] = None
+    # the epoch the matching state row's timestamps are relative to
+    epoch_s: int = 0
+    activity_slots: Dict[int, int] = dataclasses.field(default_factory=dict)
+    acts_by_name: Dict[str, int] = dataclasses.field(default_factory=dict)
+    timer_slots: Dict[str, int] = dataclasses.field(default_factory=dict)
+    child_slots: Dict[int, int] = dataclasses.field(default_factory=dict)
+    cancel_slots: Dict[int, int] = dataclasses.field(default_factory=dict)
+    signal_slots: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe form: int-keyed maps become [key, slot] pair lists
+        (JSON object keys are strings; round-tripping through str keys
+        would silently break slot seeding)."""
+        d = {
+            "next_event_id": self.next_event_id,
+            "last_version": self.last_version,
+            "version_changes": self.version_changes,
+            "pending_dec": self.pending_dec,
+            "epoch_s": self.epoch_s,
+        }
+        for f in ("activity_slots", "acts_by_name", "timer_slots",
+                  "child_slots", "cancel_slots", "signal_slots"):
+            d[f] = [[k, v] for k, v in getattr(self, f).items()]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PackResume":
+        out = cls(
+            next_event_id=int(d["next_event_id"]),
+            last_version=(
+                None if d.get("last_version") is None
+                else int(d["last_version"])
+            ),
+            version_changes=int(d.get("version_changes", 0)),
+            pending_dec=(
+                None if d.get("pending_dec") is None
+                else int(d["pending_dec"])
+            ),
+            epoch_s=int(d.get("epoch_s", 0)),
+        )
+        for f in ("activity_slots", "timer_slots", "child_slots",
+                  "cancel_slots", "signal_slots", "acts_by_name"):
+            setattr(out, f, {k: int(v) for k, v in d.get(f, [])})
+        return out
+
+
+@dataclasses.dataclass
 class WorkflowSideTable:
     """Host-side strings for one workflow, keyed by slot — merged back into
     snapshots by ops/unpack.py. Strings never influence transitions."""
@@ -103,6 +166,98 @@ class WorkflowSideTable:
     child_workflow_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
     child_run_ids: Dict[int, str] = dataclasses.field(default_factory=dict)
     child_types: Dict[int, str] = dataclasses.field(default_factory=dict)
+    # packer continuation state at the end of this history — what a
+    # checkpoint needs to resume packing from here (set by pack_workflow)
+    resume: Optional["PackResume"] = None
+
+    _SLOT_DICT_FIELDS = (
+        "cancel_targets", "signal_targets", "activity_ids",
+        "activity_task_lists", "timer_ids", "child_domains",
+        "child_workflow_ids", "child_run_ids", "child_types",
+    )
+
+    def duplicate(self) -> "WorkflowSideTable":
+        """Independent copy — resuming a pack must not mutate the stored
+        checkpoint's side table. Generic over the dataclass fields so a
+        future field cannot be silently dropped from resumed packs."""
+        out = WorkflowSideTable()
+        for f in dataclasses.fields(self):
+            if f.name == "resume":
+                continue  # the copy is about to be re-packed
+            v = getattr(self, f.name)
+            if isinstance(v, dict):
+                v = dict(v)
+            elif isinstance(v, list):
+                v = [dict(p) if isinstance(p, dict) else p for p in v]
+            setattr(out, f.name, v)
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe form (slot-keyed maps as pair lists, target tuples
+        as lists) — the checkpoint record's side-table encoding."""
+        d = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if f.name not in self._SLOT_DICT_FIELDS
+            and f.name not in ("resume", "memo", "search_attributes",
+                               "auto_reset_points")
+        }
+        d["memo"] = dict(self.memo)
+        d["search_attributes"] = dict(self.search_attributes)
+        d["auto_reset_points"] = [dict(p) for p in self.auto_reset_points]
+        for f in self._SLOT_DICT_FIELDS:
+            d[f] = [[k, list(v) if isinstance(v, tuple) else v]
+                    for k, v in getattr(self, f).items()]
+        d["resume"] = self.resume.to_dict() if self.resume else None
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkflowSideTable":
+        out = cls(
+            workflow_id=d.get("workflow_id", ""),
+            run_id=d.get("run_id", ""),
+            request_id=d.get("request_id", ""),
+            task_list=d.get("task_list", ""),
+            workflow_type=d.get("workflow_type", ""),
+            cron_schedule=d.get("cron_schedule", ""),
+            parent_domain=d.get("parent_domain", ""),
+            parent_workflow_id=d.get("parent_workflow_id", ""),
+            parent_run_id=d.get("parent_run_id", ""),
+            memo=dict(d.get("memo") or {}),
+            search_attributes=dict(d.get("search_attributes") or {}),
+            continued_execution_run_id=d.get(
+                "continued_execution_run_id", ""),
+            auto_reset_points=[dict(p) for p in
+                               d.get("auto_reset_points") or []],
+            first_decision_backoff_deadline=int(
+                d.get("first_decision_backoff_deadline", 0)),
+        )
+        for f in ("cancel_targets", "signal_targets"):
+            setattr(out, f, {
+                int(k): (v[0], v[1], v[2], bool(v[3]))
+                for k, v in d.get(f, [])
+            })
+        for f in ("activity_ids", "activity_task_lists", "timer_ids",
+                  "child_domains", "child_workflow_ids", "child_run_ids",
+                  "child_types"):
+            setattr(out, f, {int(k): v for k, v in d.get(f, [])})
+        if d.get("resume") is not None:
+            out.resume = PackResume.from_dict(d["resume"])
+        return out
+
+
+@dataclasses.dataclass
+class ResumeState:
+    """Everything needed to pack + replay a history from a cut point:
+    the packer continuation (``pack``), the side table accumulated over
+    the prefix (``side``), and the device state row at the cut
+    (``state_row``, schema.state_row form, timestamps relative to
+    ``pack.epoch_s``). Built from checkpoint records by
+    cadence_tpu/checkpoint/manager.py."""
+
+    pack: PackResume
+    side: WorkflowSideTable
+    state_row: Dict[str, Any]
 
 
 @dataclasses.dataclass
@@ -122,6 +277,9 @@ class PackedHistories:
     # concatenated valid rows ([sum(lengths), EV_N]) kept for the native
     # sidecar's fused pad+layout path; None when constructed externally
     rows_concat: Optional[np.ndarray] = None
+    # [B] StateTensors of initial carries (checkpoint resume): row i
+    # seeds history i's replay instead of empty_state; None = all empty
+    initial: Optional[Any] = None
 
     @property
     def batch(self) -> int:
@@ -172,13 +330,31 @@ MAX_TIMEOUT_S = 2**30
 
 
 class _SlotTable:
-    """Deterministic lowest-free-slot allocator keyed by an id."""
+    """Deterministic lowest-free-slot allocator keyed by an id.
 
-    def __init__(self, capacity: int, kind: str) -> None:
+    ``seed`` (a key → slot map from :class:`PackResume`) restores the
+    allocator to a mid-history state so a resumed pack assigns the same
+    slots a full pack would have."""
+
+    def __init__(self, capacity: int, kind: str,
+                 seed: Optional[Dict[Any, int]] = None) -> None:
         self.capacity = capacity
         self.kind = kind
         self.by_key: Dict[Any, int] = {}
         self.free: List[int] = list(range(capacity))  # kept sorted
+        if seed:
+            slots = list(seed.values())
+            if len(set(slots)) != len(slots):
+                raise PackError(f"resume {kind} slots collide: {seed}")
+            for slot in slots:
+                if not 0 <= slot < capacity:
+                    raise PackOverflowError(
+                        f"resume {kind} slot {slot} exceeds capacity "
+                        f"{capacity}"
+                    )
+            self.by_key = dict(seed)
+            used = set(slots)
+            self.free = [s for s in range(capacity) if s not in used]
 
     def alloc(self, key: Any) -> int:
         if not self.free:
@@ -219,6 +395,7 @@ def pack_workflow(
     request_id: str = "",
     epoch_s: Optional[int] = None,
     domain_resolver=None,
+    resume: Optional[ResumeState] = None,
 ) -> Tuple[np.ndarray, WorkflowSideTable]:
     """Pack one workflow's history (a sequence of transaction batches) into
     an [n_events, EV_N] int32 array + its side table.
@@ -231,15 +408,36 @@ def pack_workflow(
     oracle (StateBuilder) stores RESOLVED ids, and the transfer-task
     consumers look targets up by id; storing raw names here would make
     device rebuilds emit tasks whose cross-domain target can't be
-    found."""
+    found.
 
-    side = WorkflowSideTable(
-        workflow_id=workflow_id, run_id=run_id, request_id=request_id
-    )
+    ``resume``: continue packing from a checkpoint — ``batches`` is then
+    the event SUFFIX (first event id must equal the resume point's
+    next_event_id); slot tables, the side table, and version/decision
+    bookkeeping seed from the snapshot so slot assignment and
+    validation behave exactly as a full pack. The returned side's
+    ``resume`` field always carries the END state, so checkpoints
+    compose across successive resumes."""
+
+    if resume is not None:
+        side = resume.side.duplicate()
+        side.workflow_id = workflow_id or side.workflow_id
+        side.run_id = run_id or side.run_id
+        if request_id:
+            side.request_id = request_id
+    else:
+        side = WorkflowSideTable(
+            workflow_id=workflow_id, run_id=run_id, request_id=request_id
+        )
+    side.resume = None
     resolve_domain = domain_resolver or (lambda name: name)
     if epoch_s is None:
         first = next((b[0] for b in batches if b), None)
-        epoch_s = (first.timestamp // SECONDS) if first else 0
+        if first is not None:
+            epoch_s = first.timestamp // SECONDS
+        elif resume is not None:
+            epoch_s = resume.pack.epoch_s
+        else:
+            epoch_s = 0
 
     def rel_ts(ns: int) -> int:
         s = ns // SECONDS - epoch_s + 1
@@ -250,12 +448,16 @@ def pack_workflow(
                 f"timestamp {ns} out of packable window (epoch {epoch_s})"
             )
         return int(s)
-    acts = _SlotTable(caps.max_activities, "activity")
-    acts_by_name: Dict[str, int] = {}  # activity_id → live slot
-    timers = _SlotTable(caps.max_timers, "timer")
-    children = _SlotTable(caps.max_children, "child")
-    cancels = _SlotTable(caps.max_request_cancels, "request-cancel")
-    signals = _SlotTable(caps.max_signals_ext, "external-signal")
+    rp = resume.pack if resume is not None else PackResume()
+    acts = _SlotTable(caps.max_activities, "activity",
+                      seed=rp.activity_slots)
+    acts_by_name: Dict[str, int] = dict(rp.acts_by_name)
+    timers = _SlotTable(caps.max_timers, "timer", seed=rp.timer_slots)
+    children = _SlotTable(caps.max_children, "child", seed=rp.child_slots)
+    cancels = _SlotTable(caps.max_request_cancels, "request-cancel",
+                         seed=rp.cancel_slots)
+    signals = _SlotTable(caps.max_signals_ext, "external-signal",
+                         seed=rp.signal_slots)
 
     rows: List[List[int]] = []
     n_events = sum(len(b) for b in batches)
@@ -264,10 +466,13 @@ def pack_workflow(
             f"history length {n_events} exceeds max_events {caps.max_events}"
         )
 
-    version_changes = 0
-    last_version: Optional[int] = None
-    next_event_id: Optional[int] = None
-    pending_dec: Optional[int] = None  # decision schedule id currently pending
+    version_changes = rp.version_changes
+    last_version: Optional[int] = rp.last_version
+    next_event_id: Optional[int] = (
+        rp.next_event_id if resume is not None else None
+    )
+    # decision schedule id currently pending
+    pending_dec: Optional[int] = rp.pending_dec
 
     for batch in batches:
         if not batch:
@@ -431,6 +636,9 @@ def pack_workflow(
 
             elif et == EventType.StartChildWorkflowExecutionInitiated:
                 slot = children.alloc(ev.event_id)
+                # slot reuse: a prior occupant's started run id must not
+                # leak into this (not-yet-started) child's rehydration
+                side.child_run_ids.pop(slot, None)
                 side.child_domains[slot] = resolve_domain(
                     a.get("domain", "")
                 )
@@ -526,10 +734,65 @@ def pack_workflow(
                 *attrs,
             ])
 
-    arr = np.asarray(rows, dtype=np.int64)
+    arr = np.asarray(rows, dtype=np.int64).reshape(-1, S.EV_N)
     if arr.size and (arr.max() > _INT32_MAX or arr.min() < -(2**31)):
         raise PackError("event field does not fit int32")
+    side.resume = PackResume(
+        next_event_id=(next_event_id if next_event_id is not None
+                       else rp.next_event_id),
+        last_version=last_version,
+        version_changes=version_changes,
+        pending_dec=pending_dec,
+        epoch_s=epoch_s,
+        activity_slots=dict(acts.by_key),
+        acts_by_name=dict(acts_by_name),
+        timer_slots=dict(timers.by_key),
+        child_slots=dict(children.by_key),
+        cancel_slots=dict(cancels.by_key),
+        signal_slots=dict(signals.by_key),
+    )
     return arr.astype(np.int32), side
+
+
+def _resume_epoch(first_ts: List[int],
+                  resume: List[Optional[ResumeState]]) -> int:
+    """Shared batch epoch covering both suffix events and resumed state
+    rows: the minimum over first-event epochs and resume epochs, so
+    every rebased row timestamp stays >= 1 (rows only shift forward)."""
+    cands = [ts // SECONDS for ts in first_ts]
+    cands += [r.pack.epoch_s for r in resume if r is not None]
+    return min(cands) if cands else 0
+
+
+def _build_initial(
+    resume: List[Optional[ResumeState]], caps: S.Capacities,
+    epoch_s: int, n_rows: int,
+) -> Optional[S.StateTensors]:
+    """[n_rows] StateTensors with resumed histories' (rebased) snapshot
+    rows; None when nothing resumes."""
+    if not any(r is not None for r in resume):
+        return None
+    initial = S.empty_state(n_rows, caps)
+    for idx, r in enumerate(resume):
+        if r is None:
+            continue
+        delta = r.pack.epoch_s - epoch_s
+        row = S.rebase_state_row(r.state_row, delta)
+        for field, cols in S.ROW_TS_COLS.items():
+            arr = row[field]
+            for c in cols:
+                if (arr[..., c] >= MAX_REL_TS).any():
+                    raise PackOverflowError(
+                        "resumed state row timestamp out of packable "
+                        f"window after rebase (delta {delta}s)"
+                    )
+        try:
+            S.set_state_row(initial, idx, row)
+        except ValueError as e:  # shape mismatch = caps mismatch
+            raise PackOverflowError(
+                f"resume state row does not fit capacities {caps}: {e}"
+            )
+    return initial
 
 
 def pack_histories(
@@ -537,16 +800,23 @@ def pack_histories(
     caps: Optional[S.Capacities] = None,
     pad_batch_to: Optional[int] = None,
     domain_resolver=None,
+    resume: Optional[Sequence[Optional[ResumeState]]] = None,
 ) -> PackedHistories:
     """Pack many workflows into one padded [B, T, EV_N] tensor.
 
     ``histories``: sequence of (workflow_id, run_id, batches).
     ``pad_batch_to``: round the batch dim up (e.g. to a multiple of the
     device-mesh size for even sharding).
+    ``resume``: optional per-history checkpoint resume states — a
+    resumed history's batches are its event SUFFIX and its row of the
+    result's ``initial`` StateTensors carries the snapshot state.
     """
     caps = caps or S.Capacities()
     b = len(histories)
     bp = max(pad_batch_to or b, b)
+    resume = list(resume) if resume is not None else [None] * b
+    if len(resume) != b:
+        raise ValueError("resume list must align with histories")
     lengths = np.zeros((bp,), dtype=np.int32)
     side: List[WorkflowSideTable] = []
     first_ts = [
@@ -554,18 +824,20 @@ def pack_histories(
         for _, _, batches in histories
         if batches and batches[0]
     ]
-    epoch_s = min(first_ts) // SECONDS if first_ts else 0
+    epoch_s = _resume_epoch(first_ts, resume)
     per_wf: List[np.ndarray] = []
     for idx, (wf_id, run_id, batches) in enumerate(histories):
         arr, st = pack_workflow(
             batches, caps, workflow_id=wf_id, run_id=run_id,
             epoch_s=epoch_s, domain_resolver=domain_resolver,
+            resume=resume[idx],
         )
         lengths[idx] = arr.shape[0]
         side.append(st)
         per_wf.append(arr)
     for _ in range(bp - b):
         side.append(WorkflowSideTable())
+    initial = _build_initial(resume, caps, epoch_s, bp)
     rows_concat = (
         np.concatenate(per_wf, axis=0)
         if per_wf
@@ -583,7 +855,7 @@ def pack_histories(
     rows_concat.flags.writeable = False
     return PackedHistories(
         events=events, lengths=lengths, side=side, caps=caps,
-        epoch_s=epoch_s, rows_concat=rows_concat,
+        epoch_s=epoch_s, rows_concat=rows_concat, initial=initial,
     )
 
 
@@ -615,6 +887,10 @@ class PackedLanes:
         default_factory=list
     )
     seg_align: int = 1
+    # [n_histories] StateTensors of initial segment carries (checkpoint
+    # resume): row i seeds history i's segment instead of empty_state;
+    # None = every segment starts empty
+    initial: Optional[Any] = None
 
     @property
     def n_histories(self) -> int:
@@ -662,6 +938,34 @@ class PackedLanes:
         """[T, EV_N, L] field-major for the Pallas packed path."""
         return np.ascontiguousarray(np.transpose(self.events, (1, 2, 0)))
 
+    def reset_rows(self) -> np.ndarray:
+        """[L, T] int32: at each segment-end step, the ``initial`` row
+        the lane resets to — the NEXT segment's initial state. The
+        sentinel ``n_histories`` indexes the kernels' appended pristine
+        empty row (the default for non-resumed segments and lane ends)."""
+        rr = np.full(
+            (self.lanes, self.scan_len), self.n_histories, np.int32
+        )
+        for ln, segs in enumerate(self.lane_segments):
+            for k in range(len(segs) - 1):
+                rr[ln, segs[k][2] - 1] = segs[k + 1][0]
+        return rr
+
+    def lane_state0(self, initial=None) -> "S.StateTensors":
+        """[lanes] initial lane carries: each lane starts from its FIRST
+        segment's initial row (``initial``, default ``self.initial``),
+        or empty_state."""
+        initial = initial if initial is not None else self.initial
+        state0 = S.empty_state(self.lanes, self.caps)
+        if initial is None:
+            return state0
+        for ln, segs in enumerate(self.lane_segments):
+            if segs:
+                S.set_state_row(
+                    state0, ln, S.state_row(initial, segs[0][0])
+                )
+        return state0
+
 
 def pack_lanes(
     histories: Sequence[Tuple[str, str, Sequence[Sequence[HistoryEvent]]]],
@@ -671,6 +975,7 @@ def pack_lanes(
     pad_lanes_to: Optional[int] = None,
     round_lengths: bool = True,
     domain_resolver=None,
+    resume: Optional[Sequence[Optional[ResumeState]]] = None,
 ) -> PackedLanes:
     """Greedy first-fit lane packing of many workflow histories.
 
@@ -687,17 +992,27 @@ def pack_lanes(
 
     Output rows follow the input order: ``out_row`` i and ``side[i]``
     belong to ``histories[i]`` whatever lane its segment landed in.
+
+    ``resume``: optional per-history checkpoint resume states (see
+    :func:`pack_histories`) — a resumed history's batches are its event
+    SUFFIX; its row of ``PackedLanes.initial`` seeds the segment carry.
+    A zero-event suffix (checkpoint at the branch tip) still occupies
+    one ``seg_align`` block of padding rows so its segment-end flush
+    emits the (initial) state into the output row.
     """
     caps = caps or S.Capacities()
     if seg_align < 1:
         raise ValueError(f"seg_align must be >= 1, got {seg_align}")
     n = len(histories)
+    resume = list(resume) if resume is not None else [None] * n
+    if len(resume) != n:
+        raise ValueError("resume list must align with histories")
     first_ts = [
         batches[0][0].timestamp
         for _, _, batches in histories
         if batches and batches[0]
     ]
-    epoch_s = min(first_ts) // SECONDS if first_ts else 0
+    epoch_s = _resume_epoch(first_ts, resume)
     per_wf: List[np.ndarray] = []
     side: List[WorkflowSideTable] = []
     lengths = np.zeros((n,), dtype=np.int32)
@@ -706,11 +1021,12 @@ def pack_lanes(
         arr, st = pack_workflow(
             batches, caps, workflow_id=wf_id, run_id=run_id,
             epoch_s=epoch_s, domain_resolver=domain_resolver,
+            resume=resume[idx],
         )
         per_wf.append(arr)
         side.append(st)
         lengths[idx] = arr.shape[0]
-        seg_lens.append(-(-arr.shape[0] // seg_align) * seg_align)
+        seg_lens.append(-(-max(arr.shape[0], 1) // seg_align) * seg_align)
 
     max_seg = max(seg_lens, default=seg_align)
     cap_t = max(target_lane_len or 0, max_seg)
@@ -769,10 +1085,16 @@ def pack_lanes(
             cursor = end
 
     events.flags.writeable = False
+    # initial's batch dim is a jit specialization key like every other
+    # shape here: grid-round it so resumed storm chunks of arbitrary
+    # size don't each compile a fresh executable (padding rows are
+    # empty_state — the reset sentinel indexes one identically)
+    n_init = round_scan_len(n) if round_lengths else n
+    initial = _build_initial(resume, caps, epoch_s, n_init)
     return PackedLanes(
         events=events, seg_end=seg_end, out_row=out_row, lengths=lengths,
         side=side, caps=caps, epoch_s=epoch_s,
-        lane_segments=lane_segments, seg_align=seg_align,
+        lane_segments=lane_segments, seg_align=seg_align, initial=initial,
     )
 
 

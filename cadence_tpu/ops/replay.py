@@ -576,19 +576,27 @@ def replay_scan_packed(
     out_row_tm: jnp.ndarray,
     unroll: Optional[int] = None,
     types: Optional[tuple] = None,
+    init: Optional[S.StateTensors] = None,
+    reset_row_tm: Optional[jnp.ndarray] = None,
 ):
     """Scan a lane-packed event tensor (ops/pack.py pack_lanes).
 
-    ``state``: [L] lane carry (normally ``empty_state(L)``). ``out0``:
-    [n_out] output snapshot buffer, MUST be ``empty_state(n_out)`` —
-    rows never written (padding) stay pristine and lane resets reuse its
-    row 0 as the empty template. ``events_tm``/``seg_end_tm``/
-    ``out_row_tm``: [T, L(, EV_N)] from ``PackedLanes.time_major()``.
+    ``state``: [L] lane carry — ``empty_state(L)``, or each lane's FIRST
+    segment's initial row (``PackedLanes.lane_state0()``) when resuming
+    from checkpoints. ``out0``: [n_out] output snapshot buffer, MUST be
+    ``empty_state(n_out)`` — rows never written (padding) stay pristine
+    and lane resets reuse its row 0 as the empty template.
+    ``events_tm``/``seg_end_tm``/``out_row_tm``: [T, L(, EV_N)] from
+    ``PackedLanes.time_major()``.
 
     At a segment-end step each flagged lane scatters its full state into
-    its precomputed output row and resets to ``empty_state`` — so each
-    history's snapshot is bit-identical to replaying it in a lane of its
-    own. Steps with no segment end skip the flush entirely (lax.cond).
+    its precomputed output row and resets to the NEXT segment's initial
+    carry — ``empty_state`` normally, or its row of ``init`` when that
+    segment resumes from a checkpoint (``reset_row_tm``: [T, L] indices
+    into ``init``; the sentinel ``init.batch`` selects the appended
+    pristine empty row). So each history's snapshot is bit-identical to
+    replaying it alone from its initial state. Steps with no segment end
+    skip the flush entirely (lax.cond).
 
     Returns (final_lane_state, out) — callers read ``out``.
     """
@@ -598,6 +606,17 @@ def replay_scan_packed(
     n_out = out0.exec_info.shape[0]
     out_cols0 = state_to_cols(out0)
     empty_row = jax.tree_util.tree_map(lambda x: x[:1], out_cols0)
+    if init is None:
+        # single empty template row; every reset gathers row 0
+        init_cols = empty_row
+        reset_row_tm = jnp.zeros(seg_end_tm.shape, jnp.int32)
+    else:
+        if reset_row_tm is None:
+            raise ValueError("init requires reset_row_tm")
+        init_cols = jax.tree_util.tree_map(
+            lambda a, e: jnp.concatenate([a, e], axis=0),
+            state_to_cols(init), empty_row,
+        )
     # one sentinel row past the end absorbs non-flush lanes' writes
     out_mat0 = jnp.concatenate(
         [cols_to_mat(out_cols0),
@@ -612,7 +631,7 @@ def replay_scan_packed(
 
     def body(carry, xs):
         st, out = carry
-        ev, seg, idx, flush_now = xs
+        ev, seg, idx, flush_now, rrow = xs
         st = replay_step_cols(st, ev, types=types)
 
         def flush(args):
@@ -622,8 +641,10 @@ def replay_scan_packed(
                 cols_to_mat(st), mode="promise_in_bounds"
             )
             st = jax.tree_util.tree_map(
-                lambda s, e: jnp.where(_lane_mask(seg, s), e, s),
-                st, empty_row,
+                lambda s, ini: jnp.where(
+                    _lane_mask(seg, s), ini[rrow], s
+                ),
+                st, init_cols,
             )
             return st, out
 
@@ -632,7 +653,8 @@ def replay_scan_packed(
 
     (st, out), _ = lax.scan(
         body, (state_to_cols(state), out_mat0),
-        (events_tm, seg_end_tm, idx_tm, any_tm), unroll=unroll,
+        (events_tm, seg_end_tm, idx_tm, any_tm, reset_row_tm),
+        unroll=unroll,
     )
     return cols_to_state(st), mat_to_state(out[:n_out], caps)
 
@@ -645,9 +667,16 @@ replay_scan_packed_jit = jax.jit(
 
 def replay_packed_lanes(
     packed: PackedLanes, specialize: bool = True,
+    initial: Optional[S.StateTensors] = None,
 ) -> S.StateTensors:
     """Replay a lane-packed batch; returns numpy state with one row per
     history, in input order (``packed.side`` indexes it directly).
+
+    ``initial``: [n_histories] per-history initial carries (checkpoint
+    resume) — defaults to ``packed.initial`` (set by
+    ``pack_lanes(resume=...)``); each history's segment then seeds from
+    its row instead of ``empty_state``, bit-identically to replaying
+    the full history from scratch.
 
     On TPU, lanes packed with ``seg_align`` a multiple of the Pallas
     time block ride the chunked VMEM-resident kernel
@@ -655,13 +684,24 @@ def replay_packed_lanes(
     and for unaligned packings — the XLA scan handles arbitrary segment
     boundaries."""
     caps = packed.caps
+    if initial is None:
+        initial = packed.initial
     n_pad = round_scan_len(packed.n_histories)
     out0 = jax.tree_util.tree_map(
         jnp.asarray, S.empty_state(n_pad, caps)
     )
-    state0 = jax.tree_util.tree_map(
-        jnp.asarray, S.empty_state(packed.lanes, caps)
-    )
+    if initial is None:
+        state0 = jax.tree_util.tree_map(
+            jnp.asarray, S.empty_state(packed.lanes, caps)
+        )
+        init_j = None
+        reset = None
+    else:
+        state0 = jax.tree_util.tree_map(
+            jnp.asarray, packed.lane_state0(initial)
+        )
+        init_j = jax.tree_util.tree_map(jnp.asarray, initial)
+        reset = packed.reset_rows()
     types = type_signature(packed.present_types) if specialize else None
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu and packed.seg_align % 8 == 0:
@@ -671,12 +711,22 @@ def replay_packed_lanes(
             state0, out0, jnp.asarray(packed.teb()),
             jnp.asarray(packed.seg_end), jnp.asarray(packed.out_row),
             caps, tb=packed.seg_align,
+            init=init_j,
+            reset_row=None if reset is None else jnp.asarray(reset),
         )
     else:
         ev_tm, seg_tm, row_tm = packed.time_major()
+        kwargs = {}
+        if init_j is not None:
+            kwargs = dict(
+                init=init_j,
+                reset_row_tm=jnp.asarray(
+                    np.ascontiguousarray(reset.T)
+                ),
+            )
         _, out = replay_scan_packed_jit(
             state0, out0, jnp.asarray(ev_tm), jnp.asarray(seg_tm),
-            jnp.asarray(row_tm), types=types,
+            jnp.asarray(row_tm), types=types, **kwargs,
         )
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x)[: packed.n_histories], out
@@ -699,9 +749,11 @@ def replay_packed(
     the geometric shape grid (``round_scan_len``) so a storm of
     arbitrary batch sizes compiles a bounded set of executables."""
     if isinstance(packed, PackedLanes):
-        if initial is not None:
-            raise ValueError("lane-packed replay starts from empty_state")
-        return replay_packed_lanes(packed)
+        # initial: [n_histories] per-history resume carries (checkpoint
+        # rows); defaults to packed.initial from pack_lanes(resume=...)
+        return replay_packed_lanes(packed, initial=initial)
+    if initial is None:
+        initial = packed.initial
     state = initial if initial is not None else S.empty_state(packed.batch, packed.caps)
     state = jax.tree_util.tree_map(jnp.asarray, state)
     if packed.batch == 0:

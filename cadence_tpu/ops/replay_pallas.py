@@ -813,6 +813,8 @@ def replay_scan_pallas_packed(
     bt: int = BT,
     base=None,
     wide_cols: tuple = (),
+    init: S.StateTensors | None = None,
+    reset_row=None,
 ):
     """Lane-packed replay on the Pallas kernel (mirror of
     ops.replay.replay_scan_packed).
@@ -834,6 +836,14 @@ def replay_scan_pallas_packed(
     ``narrow_events_teb`` (pass its ``base`` [EV_N] int32 and static
     ``wide_cols``) — exact int32 reconstruction in-kernel, bit-identical
     output, about half the event-stream bytes the kernel is bound by.
+
+    ``init``/``reset_row``: checkpoint resume (same contract as
+    ops.replay.replay_scan_packed) — ``init`` is the [n_init] initial
+    carries and ``reset_row`` [L, T] indexes it at segment-end steps
+    (sentinel ``n_init`` = the appended empty row); ``state`` should
+    then be ``PackedLanes.lane_state0()``. Segment boundaries are
+    tb-aligned, so the between-block flush/reset needs only the
+    block-final column of ``reset_row``.
     Returns (final_lane_state, out).
     """
     if interpret is None:
@@ -892,6 +902,25 @@ def replay_scan_pallas_packed(
     empty_col = state_to_rows(
         jax.tree_util.tree_map(jnp.asarray, S.empty_state(1, caps)), rm
     )
+    if init is None:
+        # single empty template column; every reset gathers column 0
+        init_rows = empty_col
+        reset_b = jnp.zeros((T // tb, lb), jnp.int32)
+    else:
+        if reset_row is None:
+            raise ValueError("init requires reset_row")
+        n_init = init.exec_info.shape[0]
+        init_rows = jnp.concatenate(
+            [state_to_rows(jax.tree_util.tree_map(jnp.asarray, init),
+                           rm), empty_col],
+            axis=1,
+        )
+        rr = jnp.asarray(reset_row)
+        if b_pad:
+            rr = jnp.concatenate(
+                [rr, jnp.full((b_pad, T), n_init, jnp.int32)], axis=0
+            )
+        reset_b = jnp.transpose(rr[:, tb - 1 :: tb])  # [nb, lb]
     nb = T // tb
     ev_blocks = events_teb.reshape(nb, tb, ev_n, lb)
     seg_b = jnp.transpose(jnp.asarray(seg_end)[:, tb - 1 :: tb])  # [nb, lb]
@@ -899,7 +928,7 @@ def replay_scan_pallas_packed(
 
     def body(carry, xs):
         rows, out = carry
-        evb, seg, orow = xs
+        evb, seg, orow, rrow = xs
         rows = _replay_rows_pallas(
             evb, rows, caps, tb, interpret, bt, base=base,
             wide_cols=tuple(wide_cols),
@@ -909,7 +938,7 @@ def replay_scan_pallas_packed(
             rows, out = args
             idx = jnp.where(seg, orow, n_out)
             out = out.at[:, idx].set(rows, mode="drop")
-            rows = jnp.where(seg[None, :], empty_col, rows)
+            rows = jnp.where(seg[None, :], init_rows[:, rrow], rows)
             return rows, out
 
         rows, out = lax.cond(
@@ -918,7 +947,7 @@ def replay_scan_pallas_packed(
         return (rows, out), None
 
     (rows, out), _ = jax.lax.scan(
-        body, (rows0, out_rows0), (ev_blocks, seg_b, row_b)
+        body, (rows0, out_rows0), (ev_blocks, seg_b, row_b, reset_b)
     )
     return (
         rows_to_state(rows[:, :L], rm),

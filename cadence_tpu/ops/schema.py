@@ -204,6 +204,62 @@ def _register_pytree() -> None:
 _register_pytree()
 
 
+# --------------------------------------------------------------------------
+# Per-history state rows (the checkpoint subsystem's unit of persistence).
+#
+# A "state row" is one workflow's slice of a StateTensors batch as a plain
+# dict of numpy arrays — what cadence_tpu/checkpoint/ stores and what the
+# packer's resume path seeds segment carries from. Timestamps inside a row
+# are epoch-relative (the packer's rel_ts encoding), so a row moved between
+# batches with different epochs must be shifted by ``rebase_state_row``.
+# --------------------------------------------------------------------------
+
+STATE_ROW_FIELDS = (
+    "exec_info", "activities", "timers", "children", "cancels",
+    "signals", "vh_items", "vh_len",
+)
+
+# epoch-relative timestamp positions per field: (column index gated on > 0)
+ROW_TS_COLS = {
+    "exec_info": (
+        X_START_TS, X_DEC_SCHEDULED_TS, X_DEC_STARTED_TS,
+        X_DEC_ORIGINAL_SCHEDULED_TS, X_WF_EXPIRATION_TS,
+    ),
+    "activities": (
+        AC_SCHEDULED_TS, AC_STARTED_TS, AC_EXPIRATION_TS, AC_LAST_HB_TS,
+    ),
+    "timers": (TI_EXPIRY_TS,),
+}
+
+
+def state_row(state: StateTensors, b: int) -> Dict[str, Any]:
+    """Copy workflow ``b``'s slice of a StateTensors batch to a row dict."""
+    return {
+        f: np.array(np.asarray(getattr(state, f))[b], dtype=np.int32)
+        for f in STATE_ROW_FIELDS
+    }
+
+
+def set_state_row(state: StateTensors, b: int, row: Dict[str, Any]) -> None:
+    """Write a row dict into slice ``b`` of a numpy StateTensors."""
+    for f in STATE_ROW_FIELDS:
+        np.asarray(getattr(state, f))[b] = row[f]
+
+
+def rebase_state_row(row: Dict[str, Any], delta_s: int) -> Dict[str, Any]:
+    """Shift every set (non-zero) epoch-relative timestamp by ``delta_s``
+    seconds — moves a row from epoch e_old to e_new = e_old - delta_s.
+    Returns a new row; the input is untouched."""
+    out = {f: np.array(v, dtype=np.int32) for f, v in row.items()}
+    if delta_s:
+        for field, cols in ROW_TS_COLS.items():
+            arr = out[field]
+            for c in cols:
+                col = arr[..., c]
+                col[col > 0] += delta_s
+    return out
+
+
 def empty_state(batch: int, caps: Capacities) -> StateTensors:
     """Fresh (pre-start) state for `batch` workflows, numpy int32.
 

@@ -1151,6 +1151,8 @@ class HistoryEngine:
                 timer_notifier=self._timer_notifier,
                 rebuild_chunk_size=getattr(self, "rebuild_chunk_size", 0),
                 faults=getattr(self, "faults", None),
+                checkpoints=getattr(self, "checkpoints", None),
+                metrics=getattr(self, "metrics", None),
             )
         return self._ndc_replicator
 

@@ -126,4 +126,7 @@ def wrap_bundle(bundle, metrics: Scope = NOOP,
         task=deco(bundle.task, "task"),
         metadata=deco(bundle.metadata, "metadata"),
         visibility=deco(bundle.visibility, "visibility"),
+        # chaos rules on persistence.checkpoint exercise the replay
+        # plane's degrade-to-full-replay fallback
+        checkpoint=deco(getattr(bundle, "checkpoint", None), "checkpoint"),
     )

@@ -333,7 +333,14 @@ class VisibilityManager:
 
 
 class PersistenceBundle:
-    """All managers for one datastore — what a backend factory returns."""
+    """All managers for one datastore — what a backend factory returns.
+
+    ``checkpoint`` (a cadence_tpu.checkpoint.store.CheckpointStore) is
+    optional: it rides in the bundle so the decorator factory
+    (``wrap_bundle``) stacks metrics/fault-injection over checkpoint
+    I/O exactly like the five core managers, but nothing in the
+    runtime requires it — a None store simply disables checkpointed
+    incremental replay."""
 
     def __init__(
         self,
@@ -343,6 +350,7 @@ class PersistenceBundle:
         task: TaskManager,
         metadata: MetadataManager,
         visibility: VisibilityManager,
+        checkpoint=None,
     ) -> None:
         self.shard = shard
         self.execution = execution
@@ -350,6 +358,7 @@ class PersistenceBundle:
         self.task = task
         self.metadata = metadata
         self.visibility = visibility
+        self.checkpoint = checkpoint
 
     def close(self) -> None:
         pass

@@ -843,6 +843,8 @@ class MemoryVisibilityManager(I.VisibilityManager):
 
 
 def create_memory_bundle() -> I.PersistenceBundle:
+    from cadence_tpu.checkpoint.store import MemoryCheckpointStore
+
     shard = MemoryShardManager()
     return I.PersistenceBundle(
         shard=shard,
@@ -851,4 +853,5 @@ def create_memory_bundle() -> I.PersistenceBundle:
         task=MemoryTaskManager(),
         metadata=MemoryMetadataManager(),
         visibility=MemoryVisibilityManager(),
+        checkpoint=MemoryCheckpointStore(),
     )

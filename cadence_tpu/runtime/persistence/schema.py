@@ -70,11 +70,21 @@ CREATE INDEX IF NOT EXISTS idx_current_by_domain
   ON current_executions (shard_id, domain_id);
 """
 
+_V3_REPLAY_CHECKPOINTS = """
+CREATE TABLE IF NOT EXISTS replay_checkpoints (
+  branch_key TEXT, event_id INTEGER, tree_id TEXT, fingerprint TEXT,
+  created_at INTEGER, blob TEXT NOT NULL,
+  PRIMARY KEY (branch_key, event_id));
+CREATE INDEX IF NOT EXISTS idx_ckpt_tree
+  ON replay_checkpoints (tree_id, event_id);
+"""
+
 # (version, name, script) — append-only, like the reference's
 # schema/cassandra/cadence/versioned/ dirs
 MIGRATIONS: List[Tuple[int, str, str]] = [
     (1, "base", _V1_BASE),
     (2, "query indexes", _V2_QUERY_INDEXES),
+    (3, "replay checkpoints", _V3_REPLAY_CHECKPOINTS),
 ]
 
 CURRENT_SCHEMA_VERSION = MIGRATIONS[-1][0]

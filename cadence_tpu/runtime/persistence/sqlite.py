@@ -1019,6 +1019,8 @@ class SqliteVisibilityManager(I.VisibilityManager):
 
 class SqliteBundle(I.PersistenceBundle):
     def __init__(self, path: str = ":memory:", auto_setup: bool = True) -> None:
+        from cadence_tpu.checkpoint.store import SqliteCheckpointStore
+
         self._db = _Db(path, auto_setup=auto_setup)
         super().__init__(
             shard=SqliteShardManager(self._db),
@@ -1027,6 +1029,7 @@ class SqliteBundle(I.PersistenceBundle):
             task=SqliteTaskManager(self._db),
             metadata=SqliteMetadataManager(self._db),
             visibility=SqliteVisibilityManager(self._db),
+            checkpoint=SqliteCheckpointStore(self._db),
         )
 
     def close(self) -> None:

@@ -63,6 +63,8 @@ class NDCHistoryReplicator:
         timer_notifier=lambda: None,
         rebuild_chunk_size=0,
         faults=None,
+        checkpoints=None,
+        metrics=None,
     ) -> None:
         self.shard = shard
         self.domains = domains
@@ -79,6 +81,8 @@ class NDCHistoryReplicator:
             shard.persistence.history,
             domain_resolver=self._resolve_domain,
             chunk_size=rebuild_chunk_size,
+            checkpoints=checkpoints,
+            metrics=metrics,
         )
         # whether this cluster is currently active for a domain (drives
         # signal reapplication; standby clusters never mint events)
@@ -159,6 +163,10 @@ class NDCHistoryReplicator:
                 run_id=deferred[k]["task"].run_id,
                 branch_token=deferred[k]["branch_token"],
                 next_event_id=deferred[k]["next_event_id"],
+                # the target branch's items: the checkpoint manager's
+                # NDC divergence guard — a conflicting branch must not
+                # resume past its fork point
+                version_history_items=deferred[k]["vh_items"],
             )
             for k in order
         ]
@@ -368,6 +376,10 @@ class NDCHistoryReplicator:
                     "branch_index": branch_index,
                     "branch_token": target_vh.branch_token,
                     "next_event_id": target_vh.last_item().event_id + 1,
+                    "vh_items": [
+                        (it.event_id, it.version)
+                        for it in target_vh.items
+                    ],
                     "followups": [],
                 }
             self._rebuild_and_apply(ctx, ms, task, branch_index)
@@ -466,6 +478,9 @@ class NDCHistoryReplicator:
             run_id=task.run_id,
             branch_token=target_vh.branch_token,
             next_event_id=target_vh.last_item().event_id + 1,
+            version_history_items=[
+                (it.event_id, it.version) for it in target_vh.items
+            ],
         )
         rebuilt, _, _ = self.rebuilder.rebuild(req)
         # carry over the full set of branches; flip current

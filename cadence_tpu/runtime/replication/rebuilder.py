@@ -9,6 +9,15 @@ runs' histories into the dense ``[B, T, E]`` tensor and rebuilds all of
 them in ONE replay_scan on device (the north-star replication-storm /
 conflict-resolution-storm configuration), falling back per-workflow to
 the host oracle when a history exceeds device capacities.
+
+Checkpointed incremental replay (cadence_tpu/checkpoint/): with a
+``CheckpointManager`` attached, ``rebuild_many`` consults the store per
+request, fetches only the event SUFFIX past the newest valid snapshot,
+seeds the packed scan's per-segment carry from the snapshot row, and
+writes fresh checkpoints from the rebuilt state — repeat rebuilds cost
+O(new events) instead of O(depth). A checkpoint at the branch tip skips
+the device entirely (rehydrate + task refresh). Any checkpoint-plane
+failure degrades that request to a full replay.
 """
 
 from __future__ import annotations
@@ -20,13 +29,20 @@ from cadence_tpu.core.mutable_state import MutableState
 from cadence_tpu.core.state_builder import StateBuilder
 from cadence_tpu.core.task_refresher import refresh_tasks
 from cadence_tpu.core.version_history import VersionHistories
+from cadence_tpu.utils.metrics import NOOP
 
 from ..persistence.interfaces import HistoryManager
 from ..persistence.records import BranchToken
 
 
 class RebuildRequest:
-    """One run to rebuild."""
+    """One run to rebuild.
+
+    ``version_history_items``: the target branch's (event_id, version)
+    items when the caller knows them (the NDC conflict path does) —
+    the checkpoint manager's divergence guard, and the key that lets a
+    forked branch resume from a sibling's snapshot below the LCA.
+    """
 
     def __init__(
         self,
@@ -36,6 +52,7 @@ class RebuildRequest:
         branch_token: bytes,
         next_event_id: int = 0,
         request_id: str = "rebuild",
+        version_history_items: Optional[Sequence[Tuple[int, int]]] = None,
     ) -> None:
         self.domain_id = domain_id
         self.workflow_id = workflow_id
@@ -43,12 +60,14 @@ class RebuildRequest:
         self.branch_token = branch_token
         self.next_event_id = next_event_id
         self.request_id = request_id
+        self.version_history_items = version_history_items
 
 
 class StateRebuilder:
     def __init__(self, history: HistoryManager,
                  domain_resolver=lambda name: name,
-                 chunk_size=0, lane_len: int = 1024) -> None:
+                 chunk_size=0, lane_len: int = 1024,
+                 checkpoints=None, metrics=None) -> None:
         self.history = history
         self.domain_resolver = domain_resolver
         # device-dispatch chunk for rebuild_many: an int, or a callable
@@ -59,6 +78,13 @@ class StateRebuilder:
         # rebuild_many: shallow histories pack back-to-back into lanes
         # of this length instead of each padding a lane to max(depth)
         self.lane_len = lane_len
+        # checkpoint.CheckpointManager (or None: every rebuild is cold)
+        self.checkpoints = checkpoints
+        # checkpoint_hit/miss/invalidated + events_replayed_saved land
+        # here (utils/metrics_defs.py CHECKPOINT_METRICS)
+        self._metrics = (metrics if metrics is not None else NOOP).tagged(
+            layer="checkpoint"
+        )
         self._backend_chunk = 0
 
     def _resolve_chunk(self) -> int:
@@ -86,13 +112,15 @@ class StateRebuilder:
 
     # -- history paging ------------------------------------------------
 
-    def _read_batches(self, req: RebuildRequest) -> List[List[HistoryEvent]]:
+    def _read_batches(
+        self, req: RebuildRequest, min_event_id: int = 1,
+    ) -> List[List[HistoryEvent]]:
         branch = BranchToken.from_json(req.branch_token.decode())
         out: List[List[HistoryEvent]] = []
         token = 0
         while True:
             batches, token = self.history.read_history_branch(
-                branch, 1, req.next_event_id or 1 << 60,
+                branch, min_event_id, req.next_event_id or 1 << 60,
                 page_size=256, next_token=token,
             )
             out.extend(batches)
@@ -121,6 +149,52 @@ class StateRebuilder:
 
     # -- batched rebuild (device) --------------------------------------
 
+    # -- checkpoint consult --------------------------------------------
+
+    def _consult_checkpoint(self, req: RebuildRequest, caps):
+        """The resumable checkpoint for one request, or None; never
+        raises. Misses/invalidations count here (they are final); a HIT
+        counts only once the resume actually sticks
+        (``_commit_hit``/``_degrade_hit``) so a degraded resume reports
+        as the full replay it became, not as savings."""
+        from cadence_tpu.checkpoint.manager import HIT
+
+        if self.checkpoints is None:
+            return None
+        try:
+            ckpt, status = self.checkpoints.lookup(
+                req.branch_token, caps=caps,
+                version_history_items=req.version_history_items,
+                max_event_id=(
+                    req.next_event_id - 1 if req.next_event_id else None
+                ),
+            )
+        except Exception:
+            self._metrics.inc("checkpoint_miss")
+            return None
+        if status == HIT and ckpt is not None:
+            return ckpt
+        self._metrics.inc(f"checkpoint_{status}")
+        return None
+
+    def _commit_hit(self, ckpt) -> None:
+        self._metrics.inc("checkpoint_hit")
+        # events before the snapshot are never read or replayed
+        self._metrics.inc("events_replayed_saved", ckpt.event_id)
+
+    def _degrade_hit(self) -> None:
+        self._metrics.inc("checkpoint_miss")
+
+    def _record_checkpoint(self, req, packed, final, row) -> None:
+        if self.checkpoints is None:
+            return
+        self.checkpoints.maybe_record(
+            req.branch_token, final, row, packed.side[row],
+            epoch_s=packed.epoch_s, caps=packed.caps,
+            domain_id=req.domain_id, workflow_id=req.workflow_id,
+            run_id=req.run_id,
+        )
+
     def rebuild_many(
         self, reqs: Sequence[RebuildRequest], use_device: bool = True,
     ) -> List[Tuple[MutableState, list, list]]:
@@ -128,13 +202,15 @@ class StateRebuilder:
         into one [B, T, E] tensor, replays them in a single vmapped scan,
         and rehydrates MutableState per row; any run the packer cannot
         express (capacity overflow, payload-dependent transition) falls
-        back to the host oracle."""
+        back to the host oracle.
+
+        With a checkpoint manager attached each request first looks up
+        its newest valid snapshot: hits read + replay only the event
+        suffix (the snapshot row seeds the segment carry), tip hits skip
+        the device entirely, and the rebuilt tips are written back as
+        fresh checkpoints per the manager's policy."""
         if not use_device or len(reqs) == 0:
             return [self.rebuild(r) for r in reqs]
-
-        histories = []
-        for r in reqs:
-            histories.append((r.workflow_id, r.run_id, self._read_batches(r)))
 
         try:
             import jax  # noqa: F401 — device path needs a usable jax
@@ -148,14 +224,6 @@ class StateRebuilder:
         except Exception:  # jax unavailable — host path
             return [self.rebuild(r) for r in reqs]
 
-        # storm drain: depth-bucket the stream (a few deep stragglers
-        # must not stretch every lane), lane-pack each bucket (several
-        # whole histories per scan lane), and pump the chunks through
-        # the double-buffered host→device dispatcher (ops/dispatch.py)
-        # so packing batch k+1 overlaps replaying batch k; each failed
-        # chunk (capacity overflow etc.) falls back per-workflow to the
-        # host oracle
-        chunk = self._resolve_chunk()
         out: List[Optional[Tuple[MutableState, list, list]]] = (
             [None] * len(reqs)
         )
@@ -163,9 +231,66 @@ class StateRebuilder:
             domain_resolver=self.domain_resolver, lane_pack=True,
             lane_len=self.lane_len,
         )
+
+        # consult checkpoints, read only what must be replayed
+        histories = []           # pending (wf, run, suffix batches)
+        resumes = []             # aligned Optional[ResumeState]
+        pend_req: List[int] = []  # pending index -> request index
+        for gi, r in enumerate(reqs):
+            ckpt = self._consult_checkpoint(r, d.caps)
+            if ckpt is None:
+                batches = self._read_batches(r)
+                resume = None
+            else:
+                try:
+                    batches = self._read_batches(
+                        r, min_event_id=ckpt.event_id + 1
+                    )
+                    resume = self.checkpoints.resume_state(ckpt)
+                except Exception:  # degraded store/decode: full replay
+                    batches, resume = self._read_batches(r), None
+                    self._degrade_hit()
+                if resume is not None and not batches:
+                    # tip hit: nothing to replay — rehydrate directly
+                    try:
+                        ms = self.checkpoints.rehydrate(
+                            ckpt, domain_id=r.domain_id
+                        )
+                        ms.execution_info.branch_token = r.branch_token
+                        transfer, timer = refresh_tasks(ms)
+                        out[gi] = (ms, transfer, timer)
+                        self._commit_hit(ckpt)
+                        continue
+                    except Exception:
+                        batches, resume = self._read_batches(r), None
+                        self._degrade_hit()
+                if resume is not None:
+                    self._commit_hit(ckpt)
+            histories.append((r.workflow_id, r.run_id, batches))
+            resumes.append(resume)
+            pend_req.append(gi)
+
+        # storm drain: depth-bucket the stream (a few deep stragglers
+        # must not stretch every lane; a resumed run buckets by its
+        # SUFFIX depth), lane-pack each bucket (several whole histories
+        # per scan lane), and pump the chunks through the
+        # double-buffered host→device dispatcher (ops/dispatch.py) so
+        # packing batch k+1 overlaps replaying batch k; each failed
+        # chunk (capacity overflow etc.) falls back per-workflow to the
+        # host oracle
+        chunk = self._resolve_chunk()
+        n_chunks = 0
         for idxs, hs in depth_buckets(histories):
             for j in range(0, len(hs), chunk):
-                d.submit(idxs[j : j + chunk], hs[j : j + chunk])
+                sub = idxs[j : j + chunk]
+                d.submit(
+                    tuple(pend_req[i] for i in sub),
+                    hs[j : j + chunk],
+                    resume=[resumes[i] for i in sub],
+                )
+                n_chunks += 1
+        if n_chunks == 0:
+            return out
         d.finish()
         for item in d.results(strict=False):
             if isinstance(item, DispatchError):
@@ -182,4 +307,5 @@ class StateRebuilder:
                 ms.execution_info.branch_token = r.branch_token
                 transfer, timer = refresh_tasks(ms)
                 out[gi] = (ms, transfer, timer)
+                self._record_checkpoint(r, packed, final, j)
         return out

@@ -44,6 +44,7 @@ class HistoryService:
         rebuild_chunk_size: int = 0,
         faults=None,
         queue_exhausted_retry_delay_s: Optional[float] = None,
+        checkpoints=None,
     ) -> None:
         from cadence_tpu.utils.metrics import Scope
 
@@ -69,6 +70,10 @@ class HistoryService:
         # the production default)
         self.faults = faults
         self._queue_park_delay_s = queue_exhausted_retry_delay_s
+        # checkpoint.CheckpointManager (config `checkpoint:` section):
+        # every shard's state rebuilder resumes replays from durable
+        # snapshots and writes fresh ones. None = cold rebuilds only.
+        self.checkpoints = checkpoints
         self._log = get_logger(
             "cadence_tpu.history.service", host=monitor.self_identity
         )
@@ -115,6 +120,7 @@ class HistoryService:
         engine.metrics = self.metrics
         engine.rebuild_chunk_size = self.rebuild_chunk_size
         engine.faults = self.faults
+        engine.checkpoints = self.checkpoints
         engine.matching_client = self.matching_client
         has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(

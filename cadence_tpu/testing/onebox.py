@@ -41,6 +41,7 @@ class Onebox:
         faults=None,
         time_source=None,
         poll_request_id_fn=None,
+        checkpoints=None,
     ) -> None:
         self.faults = faults
         self.persistence = persistence or create_memory_bundle()
@@ -68,6 +69,17 @@ class Onebox:
         )
         self.domains = DomainCache(self.persistence.metadata)
         self.monitor = single_host_monitor(host_identity)
+        # checkpoints: True builds a CheckpointManager over the bundle's
+        # checkpoint store (fault-wrapped above when chaos is on); or
+        # pass a ready CheckpointManager; None/False = cold rebuilds
+        if checkpoints is True:
+            from cadence_tpu.checkpoint import CheckpointManager
+
+            checkpoints = (
+                CheckpointManager(self.persistence.checkpoint)
+                if self.persistence.checkpoint is not None else None
+            )
+        self.checkpoints = checkpoints or None
         self.history = HistoryService(
             num_shards, self.persistence, self.domains, self.monitor,
             cluster_metadata=self.cluster_metadata,
@@ -75,6 +87,7 @@ class Onebox:
             metrics=self.metrics,
             faults=faults,
             time_source=time_source,
+            checkpoints=self.checkpoints,
         )
         self.history_client = HistoryClient(self.history.controller)
         # the clock and the poll nonce are the two entropy sources a

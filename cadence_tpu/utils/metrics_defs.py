@@ -89,6 +89,20 @@ REPLICATION_METRICS = (
 # decorator count injected and real backend failures identically.
 FAULT_METRICS = ("faults_injected",)
 
+# checkpointed incremental replay (cadence_tpu/checkpoint/), emitted by
+# the state rebuilder under tags (layer=checkpoint): every rebuild_many
+# lookup counts exactly one of hit / miss / invalidated (invalidated =
+# candidates existed but all failed validation: stale fingerprint,
+# capacity mismatch, or NDC divergence before the snapshot), and
+# events_replayed_saved accumulates the events a hit skipped — the
+# direct measure of the O(depth) → O(new events) conversion.
+CHECKPOINT_METRICS = (
+    "checkpoint_hit",
+    "checkpoint_miss",
+    "checkpoint_invalidated",
+    "events_replayed_saved",
+)
+
 # the standard per-operation triple
 REQUESTS = "requests"
 LATENCY = "latency"

@@ -38,8 +38,8 @@ def test_smoke_emits_one_json_record():
     # a packer regression (fragmenting lanes, over-rounding) fails here
     packed_seen = 0
     for name, cfg in out["configs"].items():
-        if "histories_per_sec" not in cfg:
-            continue
+        if "histories_per_sec" not in cfg or "suffix_frac" in cfg:
+            continue  # rebuild_warm has its own contract below
         assert "padding_frac" in cfg, f"{name} lacks padding_frac"
         assert "lanes_per_history" in cfg, f"{name} lacks lanes_per_history"
         if cfg.get("packed"):
@@ -51,6 +51,16 @@ def test_smoke_emits_one_json_record():
             # only the padding contract is asserted)
             assert cfg["unpacked_padding_frac"] > cfg["padding_frac"], name
     assert packed_seen >= 1, "smoke must cover a lane-packed config"
+    # the checkpointed-incremental-replay contract: the warm pass
+    # resumes from snapshots (hit rate reported) and replays strictly
+    # less than the full event stream (suffix_frac < 1.0); a resume
+    # regression (lookups missing, suffixes not trimmed) fails here
+    warm = out["configs"]["rebuild_warm"]
+    for key in ("histories_per_sec", "cold_histories_per_sec", "vs_cold",
+                "checkpoint_hit_rate", "suffix_frac"):
+        assert key in warm, f"rebuild_warm lacks {key}"
+    assert warm["suffix_frac"] < 1.0, warm["suffix_frac"]
+    assert warm["checkpoint_hit_rate"] > 0, warm["checkpoint_hit_rate"]
 
 
 def test_watchdog_still_yields_parseable_record():

@@ -570,3 +570,139 @@ class TestChaosConfig:
             "rules": [{"site": "persistence.*"}],
         }})
         assert cfg.chaos.build_schedule() is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint plane under write faults (checkpointed incremental replay)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointChaos:
+    """Chaos rules on ``persistence.checkpoint``: a faulted snapshot
+    plane must cost only the optimization (fallback: full replay) —
+    rebuild results stay byte-identical to a host rebuild no matter
+    which checkpoint reads/writes fail or tear."""
+
+    def _seeded(self, n=5):
+        from cadence_tpu.runtime.replication.rebuilder import (
+            RebuildRequest,
+            StateRebuilder,
+        )
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        bundle = create_memory_bundle()
+        history = bundle.history
+        fz = HistoryFuzzer(seed=CHAOS_SEED)
+        reqs = []
+        for i in range(n):
+            batches = fz.generate(target_events=30 + 10 * (i % 3))
+            branch = history.new_history_branch(tree_id=f"ck-run-{i}")
+            txn = 1
+            for b in batches:
+                history.append_history_nodes(
+                    branch, b, transaction_id=txn)
+                txn += 1
+            reqs.append(RebuildRequest(
+                domain_id="dom", workflow_id=f"ck-wf-{i}",
+                run_id=f"ck-run-{i}",
+                branch_token=branch.to_json().encode(),
+            ))
+        host = [StateRebuilder(history).rebuild(r) for r in reqs]
+        return bundle, reqs, host
+
+    def test_checkpoint_write_faults_fall_back_to_full_replay(self):
+        from cadence_tpu.checkpoint import (
+            CheckpointManager,
+            CheckpointPolicy,
+        )
+        from cadence_tpu.ops.unpack import mutable_state_to_snapshot
+        from cadence_tpu.runtime.replication.rebuilder import StateRebuilder
+
+        bundle, reqs, host = self._seeded()
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.checkpoint", probability=1.0,
+                      error="PersistenceError"),
+        ])
+        scope = Scope()
+        wrapped = wrap_bundle(bundle, metrics=scope, faults=sched)
+        rb = StateRebuilder(
+            wrapped.history,
+            checkpoints=CheckpointManager(
+                wrapped.checkpoint, CheckpointPolicy(every_events=1),
+            ),
+            metrics=scope,
+        )
+        # every lookup and every write faults — results must still be
+        # byte-identical to the host rebuild, twice in a row
+        for _ in range(2):
+            out = rb.rebuild_many(reqs)
+            for (h, _, _), (o, _, _) in zip(host, out):
+                assert mutable_state_to_snapshot(h) == \
+                    mutable_state_to_snapshot(o)
+        assert sched.injected_total() > 0, "the storm never happened"
+        assert bundle.checkpoint.count_checkpoints() == 0
+        assert scope.registry.counter_value("checkpoint_hit") == 0
+
+    def test_torn_checkpoint_write_lands_and_later_resumes(self):
+        """torn_write on put_checkpoint: the snapshot LANDS while the
+        ack is lost — the write path swallows the error, and the next
+        rebuild resumes from the landed snapshot bit-identically."""
+        from cadence_tpu.checkpoint import (
+            CheckpointManager,
+            CheckpointPolicy,
+        )
+        from cadence_tpu.ops.unpack import mutable_state_to_snapshot
+        from cadence_tpu.runtime.replication.rebuilder import StateRebuilder
+
+        bundle, reqs, host = self._seeded()
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.checkpoint",
+                      method="put_checkpoint", probability=1.0,
+                      action="torn_write", error="TimeoutError"),
+        ])
+        scope = Scope()
+        wrapped = wrap_bundle(bundle, metrics=scope, faults=sched)
+        rb = StateRebuilder(
+            wrapped.history,
+            checkpoints=CheckpointManager(
+                wrapped.checkpoint, CheckpointPolicy(every_events=1),
+            ),
+            metrics=scope,
+        )
+        rb.rebuild_many(reqs)
+        assert bundle.checkpoint.count_checkpoints() == len(reqs), (
+            "torn writes must land"
+        )
+        warm = rb.rebuild_many(reqs)
+        for (h, _, _), (w, _, _) in zip(host, warm):
+            assert mutable_state_to_snapshot(h) == \
+                mutable_state_to_snapshot(w)
+        assert scope.registry.counter_value("checkpoint_hit") == len(reqs)
+
+    def test_corrupted_stored_checkpoint_degrades_to_full_replay(self):
+        from cadence_tpu.checkpoint import (
+            CheckpointManager,
+            CheckpointPolicy,
+        )
+        from cadence_tpu.ops.unpack import mutable_state_to_snapshot
+        from cadence_tpu.runtime.replication.rebuilder import StateRebuilder
+
+        bundle, reqs, host = self._seeded()
+        scope = Scope()
+        rb = StateRebuilder(
+            bundle.history,
+            checkpoints=CheckpointManager(
+                bundle.checkpoint, CheckpointPolicy(every_events=1),
+            ),
+            metrics=scope,
+        )
+        rb.rebuild_many(reqs)
+        for r in reqs:
+            key = r.branch_token.decode()
+            for ck in bundle.checkpoint.list_checkpoints(key):
+                bundle.checkpoint._corrupt(key, ck.event_id)
+        warm = rb.rebuild_many(reqs)
+        for (h, _, _), (w, _, _) in zip(host, warm):
+            assert mutable_state_to_snapshot(h) == \
+                mutable_state_to_snapshot(w)
+        assert scope.registry.counter_value("checkpoint_hit") == 0

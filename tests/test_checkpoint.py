@@ -1,0 +1,484 @@
+"""Checkpointed incremental replay: store, validation, resume parity.
+
+The subsystem's correctness bar is byte identity: a replay resumed from
+a checkpoint (host rebuild path, XLA packed scan, Pallas packed scan)
+must equal the full-history replay and the host oracle exactly — plus
+the safety rails: fingerprint/caps/LCA invalidation, retention, the
+write policy, and failure isolation (a broken checkpoint plane degrades
+to full replay, never a wrong rebuild).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cadence_tpu.checkpoint import (
+    CheckpointManager,
+    CheckpointPolicy,
+    MemoryCheckpointStore,
+    checkpoint_from_replay,
+    transition_fingerprint,
+)
+from cadence_tpu.ops import schema as S
+from cadence_tpu.ops.pack import pack_histories, pack_lanes
+from cadence_tpu.ops.replay import replay_packed
+from cadence_tpu.ops.unpack import (
+    mutable_state_to_snapshot,
+    split_lane_snapshots,
+    state_row_to_snapshot,
+)
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.persistence.records import BranchToken
+from cadence_tpu.runtime.persistence.sqlite import create_sqlite_bundle
+from cadence_tpu.runtime.replication.rebuilder import (
+    RebuildRequest,
+    StateRebuilder,
+)
+from cadence_tpu.testing.event_generator import HistoryFuzzer
+from cadence_tpu.utils.metrics import Scope
+
+CAPS = S.Capacities(max_events=256)
+
+
+def _fuzz(n, seed=11, target=40, close=False):
+    out = []
+    for i in range(n):
+        fz = HistoryFuzzer(seed=seed + i, caps=CAPS)
+        out.append((
+            f"wf-{i}", f"run-{i}",
+            fz.generate(target_events=target + (i * 13) % 60, close=close),
+        ))
+    return out
+
+
+def _branch_token(i):
+    return BranchToken(
+        tree_id=f"run-{i}", branch_id=f"branch-{i}"
+    ).to_json().encode()
+
+
+def _prefix_checkpoint(wf, run, prefix, branch_token, caps=CAPS):
+    """Replay a prefix and snapshot its end state."""
+    pk = pack_histories([(wf, run, prefix)], caps=caps)
+    pre = replay_packed(pk)
+    return checkpoint_from_replay(
+        branch_token, pre, 0, pk.side[0], pk.epoch_s, caps,
+        domain_id="dom", workflow_id=wf, run_id=run,
+    )
+
+
+# ---------------------------------------------------------------------------
+# store backends
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_store_roundtrip_order_and_prune(backend):
+    bundle = (
+        create_memory_bundle() if backend == "memory"
+        else create_sqlite_bundle()
+    )
+    try:
+        store = bundle.checkpoint
+        wf, run, batches = _fuzz(1)[0]
+        bt = _branch_token(0)
+        # three snapshots at growing prefixes of one history
+        cks = []
+        for cut in (1, max(2, len(batches) // 2), len(batches)):
+            ck = _prefix_checkpoint(wf, run, batches[:cut], bt)
+            store.put_checkpoint(ck)
+            cks.append(ck)
+        got = store.list_checkpoints(bt.decode())
+        assert [c.event_id for c in got] == sorted(
+            {c.event_id for c in cks}, reverse=True
+        ), "list must be newest-first"
+        g = got[0]
+        ref = max(cks, key=lambda c: c.event_id)
+        assert g.vh_items == ref.vh_items
+        assert g.fingerprint == transition_fingerprint()
+        assert g.resume.next_event_id == ref.resume.next_event_id
+        assert g.side.activity_ids == ref.side.activity_ids
+        for k in S.STATE_ROW_FIELDS:
+            np.testing.assert_array_equal(g.state_row[k], ref.state_row[k])
+        # tree index + retention
+        assert store.list_tree_checkpoints("run-0")
+        dropped = store.prune_tree("run-0", 1)
+        assert dropped == len(got) - 1
+        assert store.count_checkpoints() == 1
+        assert store.list_checkpoints(bt.decode())[0].event_id == g.event_id
+    finally:
+        bundle.close()
+
+
+def test_corrupted_record_is_skipped_not_raised():
+    store = MemoryCheckpointStore()
+    wf, run, batches = _fuzz(1)[0]
+    bt = _branch_token(0)
+    ck = _prefix_checkpoint(wf, run, batches, bt)
+    store.put_checkpoint(ck)
+    store._corrupt(ck.branch_key, ck.event_id)
+    assert store.list_checkpoints(ck.branch_key) == []
+    mgr = CheckpointManager(store)
+    got, status = mgr.lookup(bt, caps=CAPS)
+    assert got is None and status == "miss"
+
+
+# ---------------------------------------------------------------------------
+# validation (fingerprint / caps / LCA)
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_and_caps_invalidation():
+    store = MemoryCheckpointStore()
+    wf, run, batches = _fuzz(1)[0]
+    bt = _branch_token(0)
+    store.put_checkpoint(_prefix_checkpoint(wf, run, batches, bt))
+
+    hit, status = CheckpointManager(store).lookup(bt, caps=CAPS)
+    assert status == "hit" and hit is not None
+
+    stale = CheckpointManager(store, fingerprint="stale-kernel")
+    got, status = stale.lookup(bt, caps=CAPS)
+    assert got is None and status == "invalidated"
+
+    other_caps = S.Capacities(max_events=256, max_activities=4)
+    got, status = CheckpointManager(store).lookup(bt, caps=other_caps)
+    assert got is None and status == "invalidated"
+
+    # never resume past the rebuild target
+    got, status = CheckpointManager(store).lookup(
+        bt, caps=CAPS, max_event_id=1
+    )
+    assert got is None and status == "invalidated"
+
+
+def test_lca_divergence_invalidation_and_fork_point_resume():
+    """NDC guard: a branch that diverged BEFORE the snapshot must not
+    resume from it; a branch that diverged AFTER may resume, and (via
+    the tree index) may resume from a SIBLING branch's snapshot below
+    the fork point."""
+    store = MemoryCheckpointStore()
+    wf, run, batches = _fuzz(1, target=60)[0]
+    bt = _branch_token(0)
+    ck = _prefix_checkpoint(wf, run, batches, bt)
+    store.put_checkpoint(ck)
+    mgr = CheckpointManager(store)
+    tip = ck.event_id
+    last_ver = ck.vh_items[-1][1]
+
+    # same branch, target history extends the snapshot's lineage: hit
+    extended = ck.vh_items[:-1] + [(tip + 50, last_ver)]
+    got, status = mgr.lookup(
+        bt, caps=CAPS, version_history_items=extended
+    )
+    assert status == "hit" and got is not None
+
+    # target diverged before the snapshot (fork at tip-5, a newer
+    # version takes over): LCA(ck, target) < ck.event_id → invalidated
+    diverged = [
+        (e, v) for e, v in ck.vh_items if e < tip - 5
+    ] + [(tip - 5, last_ver), (tip + 50, last_ver + 7)]
+    got, status = mgr.lookup(
+        bt, caps=CAPS, version_history_items=diverged
+    )
+    assert got is None and status == "invalidated"
+
+    # sibling branch of the same tree, forked past the snapshot: the
+    # tree-scoped lookup finds ck even though the branch key differs
+    sibling = BranchToken(
+        tree_id="run-0", branch_id="branch-forked"
+    ).to_json().encode()
+    forked_after = ck.vh_items[:-1] + [
+        (tip + 2, last_ver), (tip + 20, last_ver + 9)
+    ]
+    got, status = mgr.lookup(
+        sibling, caps=CAPS, version_history_items=forked_after
+    )
+    assert status == "hit" and got is not None
+    assert got.branch_key == bt.decode()
+
+    # sibling WITHOUT version history items: no divergence proof — miss
+    got, status = mgr.lookup(sibling, caps=CAPS)
+    assert got is None and status == "miss"
+
+
+# ---------------------------------------------------------------------------
+# resume parity: XLA packed + Pallas packed vs full replay + oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seg_align", [1, 8])
+def test_lane_packed_resume_bit_identical(seg_align):
+    from test_replay_differential import oracle_replay
+
+    hs = _fuzz(9, seed=21)
+    full = replay_packed(pack_lanes(hs, caps=CAPS, target_lane_len=128))
+
+    resume, suffixes = [], []
+    for i, (wf, run, batches) in enumerate(hs):
+        cut = max(1, len(batches) // 2)
+        ck = _prefix_checkpoint(wf, run, batches[:cut], _branch_token(i))
+        resume.append(ck.resume_state())
+        suffixes.append((wf, run, batches[cut:]))
+
+    lanes = pack_lanes(
+        suffixes, caps=CAPS, target_lane_len=128,
+        seg_align=seg_align, resume=resume,
+    )
+    assert lanes.initial is not None
+    res = replay_packed(lanes)
+    for name in S.STATE_ROW_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res, name))[: len(hs)],
+            np.asarray(getattr(full, name))[: len(hs)],
+            err_msg=f"resumed {name} != full replay (align={seg_align})",
+        )
+    snaps = split_lane_snapshots(lanes, res)
+    for i, (wf, run, batches) in enumerate(hs):
+        oracle = mutable_state_to_snapshot(
+            oracle_replay(batches, workflow_id=wf, run_id=run)
+        )
+        assert snaps[i] == oracle, f"history {i} diverged from oracle"
+
+
+def test_pallas_packed_resume_parity_interpret():
+    """The Pallas mirror consumes the same init/reset tables; interpret
+    mode proves the between-block reset gathers the right rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from cadence_tpu.ops.pack import round_scan_len
+    from cadence_tpu.ops.replay_pallas import replay_scan_pallas_packed
+
+    hs = _fuzz(6, seed=31)
+    full = replay_packed(pack_lanes(hs, caps=CAPS, target_lane_len=128))
+    resume, suffixes = [], []
+    for i, (wf, run, batches) in enumerate(hs):
+        cut = max(1, (2 * len(batches)) // 3)
+        ck = _prefix_checkpoint(wf, run, batches[:cut], _branch_token(i))
+        resume.append(ck.resume_state())
+        suffixes.append((wf, run, batches[cut:]))
+    lanes = pack_lanes(
+        suffixes, caps=CAPS, target_lane_len=128, seg_align=8,
+        resume=resume,
+    )
+    state0 = jax.tree_util.tree_map(jnp.asarray, lanes.lane_state0())
+    out0 = jax.tree_util.tree_map(
+        jnp.asarray,
+        S.empty_state(round_scan_len(lanes.n_histories), CAPS),
+    )
+    _, out = replay_scan_pallas_packed(
+        state0, out0, jnp.asarray(lanes.teb()),
+        jnp.asarray(lanes.seg_end), jnp.asarray(lanes.out_row),
+        CAPS, tb=8, interpret=True, bt=1024,
+        init=jax.tree_util.tree_map(jnp.asarray, lanes.initial),
+        reset_row=jnp.asarray(lanes.reset_rows()),
+    )
+    out = jax.tree_util.tree_map(np.asarray, out)
+    for name in S.STATE_ROW_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out, name))[: len(hs)],
+            np.asarray(getattr(full, name))[: len(hs)],
+            err_msg=f"pallas resumed {name} != full replay",
+        )
+
+
+def test_zero_suffix_segment_emits_snapshot_state():
+    """A checkpoint at the branch tip packs as a padding-only segment
+    whose flush emits the initial state unchanged."""
+    hs = _fuzz(4, seed=41)
+    full = replay_packed(pack_lanes(hs, caps=CAPS, target_lane_len=128))
+    resume = [
+        _prefix_checkpoint(wf, run, batches, _branch_token(i))
+        .resume_state()
+        for i, (wf, run, batches) in enumerate(hs)
+    ]
+    lanes = pack_lanes(
+        [(wf, run, []) for wf, run, _ in hs],
+        caps=CAPS, target_lane_len=128, resume=resume,
+    )
+    res = replay_packed(lanes)
+    for i in range(len(hs)):
+        assert state_row_to_snapshot(res, i, lanes.epoch_s) == \
+            state_row_to_snapshot(full, i, lanes.epoch_s)
+
+
+# ---------------------------------------------------------------------------
+# rebuild_many integration
+# ---------------------------------------------------------------------------
+
+
+def _seed_history_store(history, hs):
+    reqs = []
+    for i, (wf, run, batches) in enumerate(hs):
+        branch = history.new_history_branch(tree_id=run)
+        txn = 1
+        for b in batches:
+            history.append_history_nodes(branch, b, transaction_id=txn)
+            txn += 1
+        reqs.append(RebuildRequest(
+            domain_id="dom", workflow_id=wf, run_id=run,
+            branch_token=branch.to_json().encode(),
+        ))
+    return reqs
+
+
+def test_rebuild_many_cold_then_warm_parity_and_metrics():
+    bundle = create_memory_bundle()
+    history = bundle.history
+    hs = _fuzz(8, seed=51, target=50)
+    reqs = _seed_history_store(history, hs)
+    host = [StateRebuilder(history).rebuild(r) for r in reqs]
+
+    metrics = Scope()
+    mgr = CheckpointManager(
+        bundle.checkpoint, CheckpointPolicy(every_events=1, keep_last=2)
+    )
+    rb = StateRebuilder(
+        history, lane_len=256, checkpoints=mgr, metrics=metrics
+    )
+
+    cold = rb.rebuild_many(reqs)
+    reg = metrics.registry
+    assert reg.counter_value("checkpoint_miss") == len(reqs)
+    assert bundle.checkpoint.count_checkpoints() == len(reqs)
+
+    warm = rb.rebuild_many(reqs)  # tip hits: no replay at all
+    assert reg.counter_value("checkpoint_hit") == len(reqs)
+    assert reg.counter_value("events_replayed_saved") > 0
+
+    for (h, ht, hti), (c, _, _), (w, wt, wti) in zip(host, cold, warm):
+        assert mutable_state_to_snapshot(h) == mutable_state_to_snapshot(c)
+        assert mutable_state_to_snapshot(h) == mutable_state_to_snapshot(w)
+        assert [t.task_type for t in ht] == [t.task_type for t in wt]
+        assert [
+            (t.task_type, t.visibility_timestamp) for t in hti
+        ] == [(t.task_type, t.visibility_timestamp) for t in wti]
+
+
+def test_rebuild_many_mid_history_resume_parity():
+    """Snapshots strictly inside the histories: the warm rebuild reads
+    and replays only the suffix, byte-identically to the host rebuild."""
+    bundle = create_memory_bundle()
+    history = bundle.history
+    hs = _fuzz(8, seed=61, target=60)
+    reqs = _seed_history_store(history, hs)
+    host = [StateRebuilder(history).rebuild(r) for r in reqs]
+
+    for i, (wf, run, batches) in enumerate(hs):
+        cut = max(1, len(batches) // 2)
+        bundle.checkpoint.put_checkpoint(_prefix_checkpoint(
+            wf, run, batches[:cut], reqs[i].branch_token,
+            caps=S.Capacities(),
+        ))
+    metrics = Scope()
+    rb = StateRebuilder(
+        history, lane_len=256,
+        checkpoints=CheckpointManager(
+            bundle.checkpoint, CheckpointPolicy(every_events=1 << 30)
+        ),
+        metrics=metrics,
+    )
+    warm = rb.rebuild_many(reqs)
+    assert metrics.registry.counter_value("checkpoint_hit") == len(reqs)
+    for (h, ht, _), (w, wt, _) in zip(host, warm):
+        assert mutable_state_to_snapshot(h) == mutable_state_to_snapshot(w)
+        assert [t.task_type for t in ht] == [t.task_type for t in wt]
+
+
+def test_write_policy_and_retention():
+    bundle = create_memory_bundle()
+    history = bundle.history
+    hs = _fuzz(2, seed=71, target=40)
+    reqs = _seed_history_store(history, hs)
+
+    mgr = CheckpointManager(
+        bundle.checkpoint,
+        CheckpointPolicy(every_events=1 << 30, keep_last=1),
+    )
+    rb = StateRebuilder(history, checkpoints=mgr, metrics=Scope())
+    rb.rebuild_many(reqs)
+    # first snapshot per run always writes (nothing stored yet)
+    assert bundle.checkpoint.count_checkpoints() == len(reqs)
+    created = {
+        c.event_id for r in reqs
+        for c in bundle.checkpoint.list_checkpoints(
+            r.branch_token.decode()
+        )
+    }
+    # second rebuild: tips unchanged → every_events gate skips writes
+    rb.rebuild_many(reqs)
+    after = {
+        c.event_id for r in reqs
+        for c in bundle.checkpoint.list_checkpoints(
+            r.branch_token.decode()
+        )
+    }
+    assert after == created
+    assert bundle.checkpoint.count_checkpoints() == len(reqs)
+
+
+def test_broken_store_degrades_to_full_replay():
+    class _BrokenStore(MemoryCheckpointStore):
+        def list_checkpoints(self, branch_key):
+            raise RuntimeError("store down")
+
+        def list_tree_checkpoints(self, tree_id):
+            raise RuntimeError("store down")
+
+        def put_checkpoint(self, ckpt):
+            raise RuntimeError("store down")
+
+    bundle = create_memory_bundle()
+    history = bundle.history
+    hs = _fuzz(4, seed=81)
+    reqs = _seed_history_store(history, hs)
+    host = [StateRebuilder(history).rebuild(r) for r in reqs]
+
+    metrics = Scope()
+    rb = StateRebuilder(
+        history, checkpoints=CheckpointManager(_BrokenStore()),
+        metrics=metrics,
+    )
+    out = rb.rebuild_many(reqs)
+    for (h, _, _), (o, _, _) in zip(host, out):
+        assert mutable_state_to_snapshot(h) == mutable_state_to_snapshot(o)
+    assert metrics.registry.counter_value("checkpoint_hit") == 0
+
+
+# ---------------------------------------------------------------------------
+# config wiring
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_config_section():
+    from cadence_tpu.config.static import ConfigError, load_config_dict
+
+    cfg = load_config_dict({
+        "checkpoint": {"enabled": True, "everyEvents": 64, "keepLast": 3},
+    })
+    assert cfg.checkpoint.enabled
+    mgr = cfg.checkpoint.build_manager(store=MemoryCheckpointStore())
+    assert mgr is not None
+    assert mgr.policy.every_events == 64 and mgr.policy.keep_last == 3
+
+    assert load_config_dict({}).checkpoint.build_manager() is None
+
+    with pytest.raises(ConfigError):
+        load_config_dict({"checkpoint": {"everyEvent": 1}})  # typo'd key
+    with pytest.raises(ConfigError):
+        load_config_dict({
+            "checkpoint": {"enabled": True, "everyEvents": 0},
+        })
+
+
+def test_onebox_wires_checkpoints_through_history_service():
+    from cadence_tpu.testing.onebox import Onebox
+
+    box = Onebox(num_shards=1, start_worker=False, checkpoints=True)
+    try:
+        assert box.checkpoints is not None
+        assert box.history.checkpoints is box.checkpoints
+    finally:
+        pass  # never started; nothing to stop

@@ -29,10 +29,6 @@ from cadence_tpu.runtime.replication import HistoryTaskV2
 from cadence_tpu.runtime.service import HistoryService
 
 SECOND = 1_000_000_000
-# "now": after a failover the timer pipeline becomes active for the
-# domain, and a stale start timestamp would legitimately fire the
-# workflow-timeout before the takeover assertions run
-T0 = time.time_ns()
 DOMAIN = "standby-domain"
 ACTIVE_V = 1
 
@@ -42,6 +38,15 @@ class Box:
     'active' — so every replicated workflow's tasks are standby work."""
 
     def __init__(self):
+        # "now", taken at TEST time, not module import: after a failover
+        # the timer pipeline becomes active for the domain, and a stale
+        # start timestamp would legitimately fire the workflow-timeout
+        # before the takeover assertions run. Under a loaded suite the
+        # import-to-test gap alone exceeded the 300s execution timeout
+        # (the tier-1 flake PR 2 noted) — a per-test epoch plus the
+        # widened timeout below keeps wall-clock pressure out of the
+        # assertions entirely.
+        self.t0 = time.time_ns()
         self.persistence = create_memory_bundle()
         self.domain_id = register_domain(
             self.persistence.metadata, DOMAIN, is_global=True,
@@ -111,11 +116,11 @@ def _task(box, wf, run, items, events, task_id):
 def _replicate_started_with_decision(box, wf, run):
     b1 = [
         F.workflow_execution_started(
-            1, ACTIVE_V, T0, task_list="tl", workflow_type="wt",
-            execution_start_to_close_timeout_seconds=300,
-            task_start_to_close_timeout_seconds=10,
+            1, ACTIVE_V, box.t0, task_list="tl", workflow_type="wt",
+            execution_start_to_close_timeout_seconds=3600,
+            task_start_to_close_timeout_seconds=600,
         ),
-        F.decision_task_scheduled(2, ACTIVE_V, T0),
+        F.decision_task_scheduled(2, ACTIVE_V, box.t0),
     ]
     box.engine.replicate_events_v2(
         _task(box, wf, run, [{"event_id": 2, "version": ACTIVE_V}], b1, 1)
@@ -152,7 +157,7 @@ def test_standby_holds_unreplicated_decision_and_discharges_after(box):
     assert any(t.workflow_id == wf for t in tasks), "task must be held"
 
     # replicate the started event → verification passes → discharge
-    b2 = [F.decision_task_started(3, ACTIVE_V, T0 + SECOND,
+    b2 = [F.decision_task_started(3, ACTIVE_V, box.t0 + SECOND,
                                   scheduled_event_id=2)]
     box.engine.replicate_events_v2(
         _task(box, wf, run, [{"event_id": 3, "version": ACTIVE_V}], b2, 2)
@@ -197,7 +202,7 @@ def test_timer_standby_gated_on_remote_clock(box):
     assert tm.ack.ack_level[0] == 0
 
     # advance the remote cluster's clock past every deadline
-    box.shard.set_remote_cluster_current_time("active", T0 + 3600 * SECOND)
+    box.shard.set_remote_cluster_current_time("active", box.t0 + 3600 * SECOND)
     # the decision is still pending → the timeout task is HELD (the
     # active side would fire it; standby waits for replication)
     time.sleep(0.3)

@@ -5,6 +5,7 @@ task dispatches exactly once, and acked rows are range-deleted."""
 from __future__ import annotations
 
 import threading
+import time
 
 from cadence_tpu.matching.matcher import TaskMatcher
 from cadence_tpu.matching.task_list import (
@@ -40,13 +41,34 @@ class _CountingTaskManager:
         )
 
 
-def _mgr(store):
+def _mgr(store, time_source=None):
     tl_id = TaskListID("dom", "writer-tl", TASK_TYPE_DECISION)
-    return TaskListManager(tl_id, store, TaskMatcher())
+    return TaskListManager(tl_id, store, TaskMatcher(),
+                           time_source=time_source)
 
 
 def test_storm_batches_writes_and_dispatches_exactly_once():
-    store = _CountingTaskManager(create_memory_bundle().task)
+    """Deflaked (tier-1 under parallel load): batching depends on
+    producers overlapping in the writer queue, and a loaded host can
+    stagger 250 thread starts so far apart that the pump drains
+    singletons — create_calls then reflected scheduler luck, not the
+    writer. The store's FIRST write now blocks until every producer has
+    enqueued (producers park in append() AFTER queueing, so the gate
+    cannot deadlock), making the batch shape deterministic: one gated
+    batch plus ceil(rest / MAX_BATCH) more."""
+    from cadence_tpu.matching.task_list import TaskWriter
+
+    all_enqueued = threading.Event()
+
+    class _GatedStore(_CountingTaskManager):
+        seen_tasks = 0  # tasks drained into (possibly gated) batches
+
+        def create_tasks(self, info, tasks):
+            _GatedStore.seen_tasks += len(tasks)  # before the gate
+            all_enqueued.wait(timeout=30)
+            return super().create_tasks(info, tasks)
+
+    store = _GatedStore(create_memory_bundle().task)
     mgr = _mgr(store)
     try:
         # no poller is waiting, so every add goes to the backlog; many
@@ -64,10 +86,19 @@ def test_storm_batches_writes_and_dispatches_exactly_once():
         ]
         for t in threads:
             t.start()
+        # every producer is either parked in the writer queue or inside
+        # the (gated) in-flight first batch
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if _GatedStore.seen_tasks + len(mgr._writer._queue) >= N_TASKS:
+                break
+            time.sleep(0.01)
+        all_enqueued.set()
         for t in threads:
             t.join(timeout=30)
 
-        assert store.create_calls < N_TASKS / 2, (
+        max_calls = 1 + -(-N_TASKS // TaskWriter.MAX_BATCH)
+        assert store.create_calls <= max_calls, (
             f"writer did not batch: {store.create_calls} store writes "
             f"for {N_TASKS} tasks"
         )
@@ -96,8 +127,16 @@ def test_storm_batches_writes_and_dispatches_exactly_once():
 
 
 def test_gc_is_throttled():
+    """Deflaked (tier-1 under parallel load): the GC fires on the count
+    threshold OR a 1s wall-clock interval, and on a loaded host draining
+    250 completions takes several seconds — the interval trigger then
+    fired extra range-deletes and the count-throttle assertion measured
+    host speed. A frozen clock leaves only the count threshold, which is
+    what this test is about."""
+    from cadence_tpu.utils.clock import FakeTimeSource
+
     store = _CountingTaskManager(create_memory_bundle().task)
-    mgr = _mgr(store)
+    mgr = _mgr(store, time_source=FakeTimeSource())
     try:
         for i in range(N_TASKS):
             mgr.add_task(
