@@ -1,0 +1,61 @@
+"""Static-analysis CI gate for the cadence-tpu kernel/runtime contract.
+
+Three passes, run together by ``python -m cadence_tpu.analysis``:
+
+1. **transition surface** (transition_surface.py) — the kernel's
+   event-type × column write matrix, traced at jaxpr level, diffed
+   against the host oracle's AST-extracted transition table and the
+   ops/schema.py invariants (column density, EV_A windows, epoch-rebase
+   coverage).
+2. **jit hazards** (jit_hazards.py) — recompilation, host-sync,
+   Python-branch and dtype-widening hazards over ops/ and the dispatch
+   callers.
+3. **lock order** (lock_order.py) — the runtime's lock graph:
+   acquisition-order inversions and blocking work (store I/O, sleeps,
+   joins, foreign waits) done while holding a lock.
+
+Findings gate against a checked-in baseline
+(config/lint_baseline.json): accepted findings carry a one-line
+justification; anything new exits non-zero. See analysis/README.md for
+per-rule docs and how to baseline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .findings import Baseline, BaselineEntry, Finding, dedupe
+
+PASSES = ("surface", "jit", "locks")
+
+
+def run_pass(name: str, repo_root: str) -> List[Finding]:
+    if name == "surface":
+        from . import transition_surface
+
+        return transition_surface.run(repo_root)
+    if name == "jit":
+        from . import jit_hazards
+
+        return jit_hazards.run(repo_root)
+    if name == "locks":
+        from . import lock_order
+
+        return lock_order.run(repo_root)
+    raise ValueError(f"unknown pass {name!r} (have: {PASSES})")
+
+
+def run_all(
+    repo_root: str, passes: Optional[List[str]] = None
+) -> Dict[str, List[Finding]]:
+    """{pass name → deduped findings} over the real tree."""
+    out: Dict[str, List[Finding]] = {}
+    for name in passes or PASSES:
+        out[name] = dedupe(run_pass(name, repo_root))
+    return out
+
+
+__all__ = [
+    "Baseline", "BaselineEntry", "Finding", "PASSES",
+    "dedupe", "run_all", "run_pass",
+]

@@ -1,0 +1,116 @@
+"""CLI for the static-analysis gate.
+
+    python -m cadence_tpu.analysis [--baseline config/lint_baseline.json]
+                                   [--passes surface,jit,locks]
+                                   [--emit-matrix PATH]
+                                   [--write-baseline PATH]
+                                   [--root DIR]
+
+Exit status: 0 when every finding is baselined (or none), 1 when new
+findings exist, 2 on usage/internal error. Designed to run on CPU with
+JAX_PLATFORMS=cpu in well under a minute — the kernel is *traced*, not
+executed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m cadence_tpu.analysis")
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        help="repo root (default: derived from this package's location)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON of accepted findings (config/lint_baseline.json)",
+    )
+    ap.add_argument(
+        "--passes", default=None,
+        help="comma-separated subset of passes (surface,jit,locks)",
+    )
+    ap.add_argument(
+        "--emit-matrix", default=None, metavar="PATH",
+        help="also write the transition coverage matrix JSON artifact",
+    )
+    ap.add_argument(
+        "--write-baseline", default=None, metavar="PATH",
+        help="write ALL current findings as a fresh baseline "
+        "(justifications stubbed 'TODO') and exit 0",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="only print the summary line and new findings",
+    )
+    args = ap.parse_args(argv)
+
+    from . import Baseline, BaselineEntry, run_all
+
+    passes = args.passes.split(",") if args.passes else None
+    t0 = time.monotonic()
+    try:
+        by_pass = run_all(args.root, passes=passes)
+    except Exception as e:  # a broken tree must fail loudly, not pass
+        print(f"analysis error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    if args.emit_matrix:
+        from . import transition_surface
+
+        try:
+            transition_surface.emit_matrix(args.root, args.emit_matrix)
+        except Exception as e:
+            print(
+                f"analysis error writing matrix: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        print(f"transition matrix -> {args.emit_matrix}")
+
+    all_findings = [f for fs in by_pass.values() for f in fs]
+
+    if args.write_baseline:
+        bl = Baseline([
+            BaselineEntry(rule=f.rule, anchor=f.anchor, justification="TODO")
+            for f in all_findings
+        ])
+        bl.save(args.write_baseline)
+        print(f"wrote {len(bl.entries)} baseline entries -> "
+              f"{args.write_baseline}")
+        return 0
+
+    baseline = Baseline()
+    if args.baseline:
+        baseline = Baseline.load(args.baseline)
+    new, accepted, stale = baseline.split(all_findings)
+
+    for name, fs in by_pass.items():
+        fresh = [f for f in fs if f in new]
+        if not args.quiet:
+            print(f"== pass {name}: {len(fs)} finding(s), "
+                  f"{len(fs) - len(fresh)} baselined ==")
+        for f in fresh:
+            print(f.format())
+    for e in stale:
+        print(f"warning: stale baseline entry [{e.rule}] {e.anchor} "
+              "matched nothing (fixed? remove it)", file=sys.stderr)
+
+    dt = time.monotonic() - t0
+    print(
+        f"cadence_tpu.analysis: {len(all_findings)} finding(s), "
+        f"{len(accepted)} baselined, {len(new)} new, "
+        f"{len(stale)} stale baseline entr(ies) in {dt:.1f}s"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,425 @@
+"""Pass 2 — JIT-hazard lint over the kernel stack and its callers.
+
+Vectorized-kernel throughput lives and dies on trace discipline: a
+shape that reaches a ``jit`` boundary unrounded recompiles per batch
+size, a host sync (``.item()``, ``float()``, ``np.*`` on a tracer)
+serializes the pipeline, a Python-level branch on traced data throws at
+trace time or silently constant-folds, and a dtype that widens under
+the int16 narrow event stream corrupts values mid-storm. Four AST
+rules + one trace-time dtype sweep:
+
+* ``JIT-HOST-SYNC``   — ``.item()`` / ``float()`` / ``bool()`` /
+  ``np.*`` calls inside traced functions.
+* ``JIT-PY-BRANCH``   — Python ``if``/``while``/conditional-expression
+  tests over subscripted array data or ``jnp`` calls inside traced
+  functions (``is``/``is not`` None-checks stay legal — that's how
+  static specialization is spelled).
+* ``JIT-SHAPE-ROUND`` — a function that calls a jit entry point and
+  sizes buffers from raw ``len()``/``.shape`` without ever consulting
+  ``round_scan_len`` (the geometric shape grid) — the storm-recompile
+  hazard.
+* ``JIT-NARROW-FORCE-WIDE`` — ``narrow_events_teb`` called without
+  ``force_wide``: the wide-column set must only ever grow across a
+  storm, or a later batch whose column span happens to fit int16 is
+  narrowed under a different specialization AND decoded with the wrong
+  base (the int16 widening-corruption hazard).
+* ``JIT-DTYPE-WIDEN`` (trace time) — the replay step's jaxpr must stay
+  int32/bool end to end; a leaked Python float or int64 promotion
+  doubles the HBM stream the scan is bound by.
+
+Traced-function discovery is static: roots are ``jax.jit(...)``
+wrappers, ``@jax.jit`` decorations, and kernels handed to
+``pallas_call``; the set closes over same-module calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+
+NUMPY_ALIASES = {"np", "numpy", "_np"}
+JNP_ALIASES = {"jnp"}
+SIZED_CTORS = {"empty_state", "zeros", "ones", "full", "empty"}
+
+
+# --------------------------------------------------------------------------
+# Traced-function discovery
+# --------------------------------------------------------------------------
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def _callee_name(node: ast.expr) -> Optional[str]:
+    """Unwrap ``f`` / ``partial(f, ...)`` / ``functools.partial(f, ...)``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        fn = node.func
+        is_partial = (isinstance(fn, ast.Name) and fn.id == "partial") or (
+            isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        )
+        if is_partial and node.args and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+    return None
+
+
+def traced_functions(tree: ast.Module) -> Set[str]:
+    """Module-level function names whose bodies run at trace time."""
+    fns: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+    }
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            # X = jax.jit(f, ...)
+            if _is_jax_jit(node.func) and node.args:
+                name = _callee_name(node.args[0])
+                if name:
+                    roots.add(name)
+            # pallas_call(kernel_or_partial, ...)
+            fname = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name) else ""
+            )
+            if fname == "pallas_call" and node.args:
+                name = _callee_name(node.args[0])
+                if name:
+                    roots.add(name)
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec) or (
+                    isinstance(dec, ast.Call)
+                    and (
+                        _is_jax_jit(dec.func)
+                        or (
+                            dec.args
+                            and _is_jax_jit(dec.args[0])
+                        )
+                    )
+                ):
+                    roots.add(node.name)
+
+    # call-graph closure within the module
+    calls: Dict[str, Set[str]] = {}
+    for name, fn in fns.items():
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+        calls[name] = out
+    traced = {r for r in roots if r in fns}
+    frontier = list(traced)
+    while frontier:
+        cur = frontier.pop()
+        for callee in calls.get(cur, ()):
+            if callee in fns and callee not in traced:
+                traced.add(callee)
+                frontier.append(callee)
+    return traced
+
+
+# --------------------------------------------------------------------------
+# AST rules
+# --------------------------------------------------------------------------
+
+
+def _contains(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+def _is_jnp_call(n: ast.AST) -> bool:
+    return (
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and isinstance(n.func.value, ast.Name)
+        and n.func.value.id in JNP_ALIASES
+    )
+
+
+def _is_static_none_check(test: ast.expr) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def _lint_traced_fn(
+    fn: ast.FunctionDef, relpath: str, findings: List[Finding]
+) -> None:
+    anchor = f"{relpath}:{fn.name}"
+    for node in ast.walk(fn):
+        # .item() — device→host sync inside a trace
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+        ):
+            findings.append(Finding(
+                "JIT-HOST-SYNC", f"{anchor}:item",
+                f"{relpath}:{node.lineno}: .item() in traced function "
+                f"{fn.name} forces a device sync at trace time",
+            ))
+        # np.* inside a trace: silently materializes the tracer
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in NUMPY_ALIASES
+        ):
+            findings.append(Finding(
+                "JIT-HOST-SYNC", f"{anchor}:np.{node.func.attr}",
+                f"{relpath}:{node.lineno}: numpy call "
+                f"np.{node.func.attr}(...) in traced function {fn.name} "
+                "materializes the tracer on host",
+            ))
+        # float()/bool() of a dynamic expression
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "bool")
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            findings.append(Finding(
+                "JIT-HOST-SYNC", f"{anchor}:{node.func.id}",
+                f"{relpath}:{node.lineno}: {node.func.id}(...) on a "
+                f"dynamic value in traced function {fn.name} is a "
+                "trace-time host sync (ConcretizationTypeError on "
+                "real tracers)",
+            ))
+        # Python control flow over traced data
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+            if _is_static_none_check(test):
+                continue
+            if _contains(
+                test,
+                lambda n: isinstance(n, ast.Subscript) or _is_jnp_call(n),
+            ):
+                findings.append(Finding(
+                    "JIT-PY-BRANCH", f"{anchor}:branch",
+                    f"{relpath}:{test.lineno}: Python branch on "
+                    f"subscripted/jnp-derived data in traced function "
+                    f"{fn.name} — the branch freezes at trace time "
+                    "(or raises on a real tracer)",
+                ))
+
+
+def _lint_shape_round(
+    fn: ast.FunctionDef,
+    relpath: str,
+    jit_entries: Set[str],
+    findings: List[Finding],
+) -> None:
+    calls_jit = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = (
+                node.func.id
+                if isinstance(node.func, ast.Name)
+                else node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else ""
+            )
+            if name in jit_entries or name.endswith("_jit"):
+                calls_jit = True
+    if not calls_jit:
+        return
+    rounds = _contains(
+        fn,
+        lambda n: isinstance(n, ast.Call)
+        and (
+            (isinstance(n.func, ast.Name)
+             and n.func.id in ("round_scan_len", "pack_histories",
+                              "pack_lanes"))
+            or (isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("round_scan_len", "pack_histories",
+                                    "pack_lanes"))
+        ),
+    )
+    if rounds:
+        return
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)):
+            continue
+        name = (
+            node.func.id
+            if isinstance(node.func, ast.Name)
+            else node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else ""
+        )
+        if name not in SIZED_CTORS or not node.args:
+            continue
+        size_arg = node.args[0]
+        raw_sized = _contains(
+            size_arg,
+            lambda n: (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id == "len"
+            )
+            or (isinstance(n, ast.Attribute) and n.attr == "shape"),
+        )
+        if raw_sized:
+            findings.append(Finding(
+                "JIT-SHAPE-ROUND", f"{relpath}:{fn.name}:{name}",
+                f"{relpath}:{node.lineno}: {fn.name} sizes a buffer from "
+                "raw len()/shape and feeds a jit entry point without "
+                "round_scan_len — every distinct batch size compiles a "
+                "fresh executable",
+            ))
+
+
+def _lint_narrow_force_wide(
+    tree: ast.Module, relpath: str, findings: List[Finding]
+) -> None:
+    seen = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (
+            node.func.id
+            if isinstance(node.func, ast.Name)
+            else node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else ""
+        )
+        if name != "narrow_events_teb":
+            continue
+        seen += 1
+        if not any(k.arg == "force_wide" for k in node.keywords):
+            findings.append(Finding(
+                "JIT-NARROW-FORCE-WIDE", f"{relpath}:narrow#{seen}",
+                f"{relpath}:{node.lineno}: narrow_events_teb() without "
+                "force_wide= — the wide-column set must grow "
+                "monotonically across a storm or int16 decoding "
+                "corrupts later batches",
+            ))
+
+
+def _jit_entry_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jax_jit(node.value.func):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def lint_source(source: str, relpath: str) -> List[Finding]:
+    """All AST rules over one module's source."""
+    tree = ast.parse(source)
+    findings: List[Finding] = []
+    traced = traced_functions(tree)
+    jit_entries = _jit_entry_names(tree)
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name in traced:
+            _lint_traced_fn(node, relpath, findings)
+        else:
+            _lint_shape_round(node, relpath, jit_entries, findings)
+    # methods of classes (dispatch pumps) get the shape rule too
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    _lint_shape_round(
+                        item, relpath,
+                        jit_entries | {"replay_scan_pallas_teb",
+                                       "replay_scan_pallas_packed"},
+                        findings,
+                    )
+    _lint_narrow_force_wide(tree, relpath, findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Trace-time dtype sweep
+# --------------------------------------------------------------------------
+
+ALLOWED_DTYPES = {"int32", "bool"}
+
+
+def trace_dtype_findings(closed, anchor: str) -> List[Finding]:
+    """Flag any intermediate/output aval outside int32/bool in a jaxpr."""
+    bad: Dict[str, int] = {}
+    jaxpr = closed.jaxpr
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt and dt not in ALLOWED_DTYPES:
+                bad[dt] = bad.get(dt, 0) + 1
+    return [
+        Finding(
+            "JIT-DTYPE-WIDEN", f"{anchor}:{dt}",
+            f"{anchor}: {n} traced intermediate(s) of dtype {dt} — the "
+            "replay carry must stay int32/bool (widening doubles the "
+            "HBM stream; floats break bit-parity with the oracle)",
+        )
+        for dt, n in sorted(bad.items())
+    ]
+
+
+def check_step_dtypes() -> List[Finding]:
+    """Dtype sweep of the unspecialized replay step."""
+    import jax
+    import numpy as np
+
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.ops.replay import replay_step_cols, state_to_cols
+
+    caps = S.Capacities(
+        max_events=8, max_activities=3, max_timers=2, max_children=2,
+        max_request_cancels=2, max_signals_ext=2, max_version_items=2,
+    )
+    cols = state_to_cols(S.empty_state(4, caps))
+    ev = np.zeros((4, S.EV_N), np.int32)
+    closed = jax.make_jaxpr(lambda c, e: replay_step_cols(c, e))(cols, ev)
+    return trace_dtype_findings(closed, "ops/replay.py:replay_step_cols")
+
+
+# --------------------------------------------------------------------------
+# Orchestration
+# --------------------------------------------------------------------------
+
+SCOPE = (
+    "cadence_tpu/ops",
+    "cadence_tpu/runtime/replication/rebuilder.py",
+    "cadence_tpu/checkpoint/manager.py",
+)
+
+
+def run(repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in SCOPE:
+        path = os.path.join(repo_root, entry)
+        files = []
+        if os.path.isdir(path):
+            files = [
+                os.path.join(path, f)
+                for f in sorted(os.listdir(path))
+                if f.endswith(".py")
+            ]
+        elif os.path.isfile(path):
+            files = [path]
+        for fpath in files:
+            rel = os.path.relpath(fpath, repo_root)
+            with open(fpath) as f:
+                findings += lint_source(f.read(), rel)
+    findings += check_step_dtypes()
+    return findings

@@ -1,0 +1,386 @@
+"""Pass 3 — concurrency lint: lock graph + blocking-calls-under-lock.
+
+The runtime is a thread pile: queue pumps, worker pools, ack sweeps,
+replication appliers, the shard sequencer. This pass builds, purely
+from the AST:
+
+* a **lock inventory** — ``self.x = threading.Lock()/RLock()/
+  Condition()`` attributes per class, plus local locks;
+* a **lock-order graph** — an edge A→B wherever B is acquired while A
+  is held (nested ``with``, ``.acquire()``, or a same-class method call
+  that acquires B), with cycle (inversion) detection;
+* **blocking-call-under-lock** findings — store I/O (persistence
+  managers, sqlite cursors), ``time.sleep``, ``.join()``, blocking
+  queue ``get``/``put``, and ``.wait()`` on anything *other than the
+  condition being held* (waiting on a held Condition releases it; an
+  Event.wait under someone else's lock stalls every other holder).
+
+Known limits (documented, deliberate): cross-class propagation only
+happens through attribute-name heuristics (a call whose receiver chain
+mentions ``persistence``/store managers counts as I/O), and dynamic
+dispatch through callbacks is matched by callable-attribute *name*
+(e.g. ``self._update_shard_ack(...)``). Non-blocking try-locks
+(``acquire(blocking=False)``) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# method names that are store/persistence I/O wherever they appear
+STORE_METHODS = {
+    "update_shard", "create_shard", "get_shard",
+    "append_history_nodes", "read_history_branch", "new_history_branch",
+    "get_workflow_execution", "update_workflow_execution",
+    "create_workflow_execution", "delete_workflow_execution",
+    "get_transfer_tasks", "get_timer_tasks",
+    "range_complete_transfer_tasks", "range_complete_timer_tasks",
+    "complete_transfer_task", "complete_timer_task",
+    "list_domains", "get_domain", "update_domain",
+    "put_checkpoint", "list_checkpoints", "list_tree_checkpoints",
+    "delete_checkpoint", "prune_tree",
+    "execute", "executemany", "executescript", "commit",
+}
+
+# receiver-chain substrings that mark a call as store I/O
+STORE_RECEIVERS = ("persistence", "_conn", ".store", ".shard.")
+
+ALWAYS_BLOCKING_ATTRS = {"sleep", "join"}
+
+# callable-attribute name fragments treated as blocking when invoked
+BLOCKING_CALLABLE_HINTS = ("update_shard",)
+
+
+def _dotted(node: ast.expr) -> str:
+    """Best-effort dotted string of an expression ("self._lock",
+    "self.persistence.shard.update_shard", "ctx.lock")."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{_dotted(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{_dotted(node.value)}[]"
+    return "<expr>"
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    receiver: str
+    lineno: int
+    why: str
+
+
+def _blocking_reason(
+    node: ast.Call, held: Tuple[str, ...], queue_attrs: Set[str]
+) -> Optional[str]:
+    """Why this call is blocking, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        recv = _dotted(fn.value)
+        attr = fn.attr
+        if attr in ALWAYS_BLOCKING_ATTRS:
+            return f"{recv}.{attr}() blocks"
+        if attr == "wait":
+            # waiting on the condition you hold releases it; anything
+            # else parks the thread with the lock still held
+            if recv in held:
+                return None
+            return f"{recv}.wait() parks the thread while locked"
+        if attr == "acquire":
+            for kw in node.keywords:
+                if (
+                    kw.arg == "blocking"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                ):
+                    return None
+            if recv in held:
+                return None  # re-entrant acquire of the held lock
+            return None  # plain acquire handled as a lock edge, not I/O
+        if attr in ("get", "put") and recv.rsplit(".", 1)[-1] in queue_attrs:
+            for kw in node.keywords:
+                if kw.arg == "block" and isinstance(
+                    kw.value, ast.Constant
+                ) and kw.value.value is False:
+                    return None
+                if kw.arg == "timeout" and isinstance(
+                    kw.value, ast.Constant
+                ) and kw.value.value == 0:
+                    return None
+            return f"queue {recv}.{attr}() can block on capacity"
+        if attr in STORE_METHODS:
+            return f"store I/O {recv}.{attr}(...)"
+        if any(s in recv for s in STORE_RECEIVERS):
+            # receiver chain names a store manager: any method on it is
+            # I/O even if the name isn't in STORE_METHODS
+            return f"store I/O {recv}.{attr}(...)"
+    elif isinstance(fn, ast.Name):
+        pass
+    # callable attributes by name: self._update_shard_ack(...)
+    if isinstance(fn, ast.Attribute) and any(
+        h in fn.attr for h in BLOCKING_CALLABLE_HINTS
+    ):
+        return f"callable {_dotted(fn)}(...) persists shard state"
+    return None
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    qualname: str               # Class.method or function name
+    acquires: Set[str]          # lock attrs acquired anywhere (self-relative)
+    blocking: List[BlockingCall]            # blocking calls ANYWHERE in body
+    under_lock: List[Tuple[str, BlockingCall]]   # (held lock, call)
+    edges: List[Tuple[str, str, int]]       # (held, acquired, lineno)
+    self_calls_under_lock: List[Tuple[str, str, int]]  # (held, method, line)
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    def __init__(self, qualname: str, lock_names: Set[str],
+                 queue_attrs: Set[str]) -> None:
+        self.info = MethodInfo(
+            qualname=qualname, acquires=set(), blocking=[],
+            under_lock=[], edges=[], self_calls_under_lock=[],
+        )
+        self.lock_names = lock_names
+        self.queue_attrs = queue_attrs
+        self.held: List[str] = []
+
+    # -- helpers -------------------------------------------------------
+
+    def _is_known_lock(self, dotted: str) -> bool:
+        last = dotted.rsplit(".", 1)[-1]
+        return last in self.lock_names or _lockish_name(last)
+
+    def _enter_lock(self, dotted: str, body, lineno: int) -> None:
+        if self.held:
+            self.info.edges.append((self.held[-1], dotted, lineno))
+        self.info.acquires.add(dotted)
+        self.held.append(dotted)
+        for stmt in body:
+            self.visit(stmt)
+        self.held.pop()
+
+    # -- visitors ------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        lock_expr = None
+        for item in node.items:
+            d = _dotted(item.context_expr)
+            if self._is_known_lock(d):
+                lock_expr = d
+                break
+        if lock_expr is not None:
+            self._enter_lock(lock_expr, node.body, node.lineno)
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        reason = _blocking_reason(node, tuple(self.held), self.queue_attrs)
+        if reason is not None:
+            call = BlockingCall(
+                receiver=_dotted(node.func), lineno=node.lineno, why=reason
+            )
+            self.info.blocking.append(call)
+            if self.held:
+                self.info.under_lock.append((self.held[-1], call))
+        # blocking .acquire() of another lock while one is held = edge
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            recv = _dotted(node.func.value)
+            nonblocking = any(
+                kw.arg == "blocking"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords
+            )
+            if not nonblocking and self._is_known_lock(recv):
+                if self.held and recv != self.held[-1]:
+                    self.info.edges.append(
+                        (self.held[-1], recv, node.lineno)
+                    )
+                self.info.acquires.add(recv)
+        # self.method(...) under a held lock → propagation candidate
+        if (
+            self.held
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+        ):
+            self.info.self_calls_under_lock.append(
+                (self.held[-1], node.func.attr, node.lineno)
+            )
+        self.generic_visit(node)
+
+
+def _lockish_name(name: str) -> bool:
+    """Does this attribute name look like a lock/condition object?"""
+    n = name.rsplit(".", 1)[-1]
+    return (
+        "lock" in n
+        or n.lstrip("_") in ("cond", "condition", "cv")
+        or n.endswith("_cond")
+    )
+
+
+@dataclasses.dataclass
+class ClassAnalysis:
+    module: str
+    name: str
+    lock_attrs: Set[str]
+    queue_attrs: Set[str]
+    methods: Dict[str, MethodInfo]
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Tuple[Set[str], Set[str]]:
+    locks: Set[str] = set()
+    queues: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        fname = (
+            v.func.attr if isinstance(v.func, ast.Attribute)
+            else v.func.id if isinstance(v.func, ast.Name) else ""
+        )
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                if fname in LOCK_FACTORIES:
+                    locks.add(tgt.attr)
+                elif fname == "Queue":
+                    queues.add(tgt.attr)
+            elif isinstance(tgt, ast.Name) and fname in LOCK_FACTORIES:
+                locks.add(tgt.id)
+    return locks, queues
+
+
+def analyze_module(source: str, relmodule: str) -> List[ClassAnalysis]:
+    tree = ast.parse(source)
+    out: List[ClassAnalysis] = []
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        locks, queues = _class_lock_attrs(node)
+        methods: Dict[str, MethodInfo] = {}
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef):
+                v = _MethodVisitor(
+                    f"{node.name}.{item.name}", locks, queues
+                )
+                for stmt in item.body:
+                    v.visit(stmt)
+                methods[item.name] = v.info
+        out.append(ClassAnalysis(
+            module=relmodule, name=node.name,
+            lock_attrs=locks, queue_attrs=queues, methods=methods,
+        ))
+    return out
+
+
+def _lock_id(cls: ClassAnalysis, dotted: str) -> str:
+    """Stable lock identity: module:Class.attr for self locks, else the
+    dotted expression itself."""
+    last = dotted.rsplit(".", 1)[-1]
+    if dotted.startswith("self.") and last in cls.lock_attrs:
+        return f"{cls.module}:{cls.name}.{last}"
+    return f"{cls.module}:{cls.name}:{dotted}"
+
+
+def collect_findings(classes: List[ClassAnalysis]) -> List[Finding]:
+    findings: List[Finding] = []
+    # edge map for inversion detection across the whole scope
+    edges: Dict[Tuple[str, str], str] = {}
+
+    for cls in classes:
+        for mname, info in cls.methods.items():
+            # direct blocking calls under a held lock
+            for held, call in info.under_lock:
+                findings.append(Finding(
+                    "LOCK-BLOCKING",
+                    f"{cls.module}:{cls.name}.{mname}:"
+                    f"{held.rsplit('.', 1)[-1]}:"
+                    f"{call.receiver.rsplit('.', 1)[-1]}",
+                    f"{cls.module}:{call.lineno}: {cls.name}.{mname} "
+                    f"holds {held} while {call.why}",
+                ))
+            # self-calls under lock into methods that block anywhere
+            for held, callee, line in info.self_calls_under_lock:
+                target = cls.methods.get(callee)
+                if target is None:
+                    continue
+                if target.blocking and not target.under_lock:
+                    why = target.blocking[0].why
+                    findings.append(Finding(
+                        "LOCK-BLOCKING",
+                        f"{cls.module}:{cls.name}.{mname}:"
+                        f"{held.rsplit('.', 1)[-1]}:{callee}",
+                        f"{cls.module}:{line}: {cls.name}.{mname} holds "
+                        f"{held} while calling self.{callee}() which "
+                        f"does blocking work ({why})",
+                    ))
+                # lock edges through the callee
+                for acq in target.acquires:
+                    a, b = _lock_id(cls, held), _lock_id(cls, acq)
+                    if a != b:
+                        edges.setdefault(
+                            (a, b),
+                            f"{cls.module}:{line} "
+                            f"({cls.name}.{mname} → self.{callee})",
+                        )
+            # direct nesting edges
+            for held, acquired, line in info.edges:
+                a, b = _lock_id(cls, held), _lock_id(cls, acquired)
+                if a != b:
+                    edges.setdefault(
+                        (a, b),
+                        f"{cls.module}:{line} ({cls.name}.{mname})",
+                    )
+
+    # inversions: both A→B and B→A observed anywhere in scope
+    reported: Set[Tuple[str, str]] = set()
+    for (a, b), where in sorted(edges.items()):
+        if (b, a) in edges and (b, a) not in reported:
+            reported.add((a, b))
+            findings.append(Finding(
+                "LOCK-INVERSION",
+                f"inversion:{min(a, b)}<->{max(a, b)}",
+                f"inconsistent acquisition order: {a} → {b} at {where} "
+                f"but {b} → {a} at {edges[(b, a)]} — deadlock-capable",
+            ))
+    return findings
+
+
+SCOPE_DIRS = ("cadence_tpu/runtime", "cadence_tpu/checkpoint")
+
+
+def run(repo_root: str) -> List[Finding]:
+    classes: List[ClassAnalysis] = []
+    for scope in SCOPE_DIRS:
+        base = os.path.join(repo_root, scope)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _, files in os.walk(base):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fname)
+                rel = os.path.relpath(fpath, repo_root)
+                with open(fpath) as f:
+                    classes += analyze_module(f.read(), rel)
+    return collect_findings(classes)

@@ -260,6 +260,64 @@ def rebase_state_row(row: Dict[str, Any], delta_s: int) -> Dict[str, Any]:
     return out
 
 
+# (prefix, count constant) per column table — the reflection surface
+# shared with cadence_tpu/analysis/transition_surface.py
+_COLUMN_GROUPS = (
+    ("EV_", "EV_N"), ("X_", "X_N"), ("AC_", "AC_N"), ("TI_", "TI_N"),
+    ("CH_", "CH_N"), ("RC_", "RC_N"), ("SG_", "SG_N"),
+)
+
+
+def validate(ns: Dict[str, Any] = None) -> None:
+    """Assert column-constant density and uniqueness, and that every
+    ROW_TS_COLS entry names a real column of its field.
+
+    The cheapest invariant of the transition-surface checker
+    (cadence_tpu/analysis/), also enforced at import time so a botched
+    column renumber fails the FIRST import, not the next lint run. Cost
+    is a few hundred dict lookups.
+    """
+    ns = ns if ns is not None else globals()
+    for prefix, count_name in _COLUMN_GROUPS:
+        n = ns[count_name]
+        seen: Dict[int, str] = {}
+        for k, v in ns.items():
+            if not k.startswith(prefix) or k == count_name:
+                continue
+            if not isinstance(v, int) or isinstance(v, bool):
+                continue
+            if v in seen:
+                raise AssertionError(
+                    f"schema column collision: {seen[v]} and {k} both = {v}"
+                )
+            if not 0 <= v < n:
+                raise AssertionError(
+                    f"schema column {k} = {v} outside [0, {count_name}={n})"
+                )
+            seen[v] = k
+        if len(seen) != n:
+            missing = sorted(set(range(n)) - set(seen))
+            raise AssertionError(
+                f"schema columns not dense: {prefix}* has no constant for "
+                f"value(s) {missing} under {count_name}={n}"
+            )
+    counts = {
+        "exec_info": ns["X_N"], "activities": ns["AC_N"],
+        "timers": ns["TI_N"], "children": ns["CH_N"],
+        "cancels": ns["RC_N"], "signals": ns["SG_N"],
+    }
+    for field, cols in ns["ROW_TS_COLS"].items():
+        for c in cols:
+            if not 0 <= c < counts[field]:
+                raise AssertionError(
+                    f"ROW_TS_COLS[{field!r}] column {c} outside its table "
+                    f"(N={counts[field]})"
+                )
+
+
+validate()
+
+
 def empty_state(batch: int, caps: Capacities) -> StateTensors:
     """Fresh (pre-start) state for `batch` workflows, numpy int32.
 

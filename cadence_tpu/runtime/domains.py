@@ -45,15 +45,25 @@ class DomainCache:
 
     def _refresh_if_stale(self) -> None:
         v = self.metadata.get_metadata_version()
+        with self._lock:
+            if v <= self._version:
+                return
+        # read the store OUTSIDE the lock: every domain lookup funnels
+        # through this cache, and a slow metadata scan under the lock
+        # would stall all of them (queue workers, allocators, frontend)
+        # behind one refresher. The version recheck below makes a
+        # concurrent refresh benign: whoever applies last wins only if
+        # its snapshot is newer.
+        records = self.metadata.list_domains()
         failovers = []
         with self._lock:
-            if v == self._version:
+            if v <= self._version:
                 return
             old_active = self._active_cluster
             self._active_cluster = {}
             self._by_id.clear()
             self._by_name.clear()
-            for rec in self.metadata.list_domains():
+            for rec in records:
                 self._by_id[rec.info.id] = rec
                 self._by_name[rec.info.name] = rec
                 new_cluster = rec.replication_config.active_cluster_name

@@ -269,9 +269,15 @@ class HistoryFuzzer:
             emit(batch)
 
         if not closed and close:
-            # hard close: terminate (legal at any point)
+            # hard close from the environment: terminate or time out
+            # (both legal at any point; timeout is how the timer queue
+            # closes an expired run, so replayers must accept it too)
             bump_time()
-            emit([F.workflow_execution_terminated(next_id(), v, t, reason="fuzz-end")])
+            if rng.random() < 0.25:
+                emit([F.workflow_execution_timed_out(next_id(), v, t)])
+            else:
+                emit([F.workflow_execution_terminated(
+                    next_id(), v, t, reason="fuzz-end")])
         return batches
 
     # ------------------------------------------------------------------
@@ -301,6 +307,7 @@ class HistoryFuzzer:
         if b.signals:
             options.append("signal_resolve")
         options.append("wf_signal")
+        options.append("wf_cancel_request")
         choice = rng.choice(options)
 
         if choice == "act_start":
@@ -390,6 +397,11 @@ class HistoryFuzzer:
                 ev = F.signal_external_failed(
                     b.next_id(), b.v, b.t, initiated_event_id=init)
             b.emit([ev])
+        elif choice == "wf_cancel_request":
+            # workflow-level cancel request: legal at any point while
+            # running, idempotent on repeat (both replayers set a flag)
+            b.emit([F.workflow_execution_cancel_requested(
+                b.next_id(), b.v, b.t)])
         else:
             b.emit([F.workflow_execution_signaled(
                 b.next_id(), b.v, b.t, signal_name=f"sig-{rng.randint(0, 9)}")])

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Static-analysis CI gate (cadence_tpu/analysis): transition-surface
+# checker, JIT-hazard lint, lock-order analysis.
+#
+#   scripts/run_lint.sh                    # gate against the baseline
+#   scripts/run_lint.sh --emit-matrix build/transition_matrix.json
+#   scripts/run_lint.sh --passes locks     # one pass only
+#
+# Runs on CPU (the kernel is traced, not executed); non-zero exit on
+# any finding not in config/lint_baseline.json. Tier-1 covers the same
+# gate in-process via tests/test_static_analysis.py; this wrapper is
+# the standalone/CI entry.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+exec python -m cadence_tpu.analysis \
+    --baseline config/lint_baseline.json "$@"

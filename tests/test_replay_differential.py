@@ -180,6 +180,64 @@ def child_external_batches(t=T0):
             10, V, t + 3 * SECOND, initiated_event_id=7)],
         [F.child_execution_completed(11, V, t + 4 * SECOND, initiated_event_id=5,
                                      started_event_id=8)],
+        # second decision fans out three more children + one external
+        # cancel so every child-close kind (failed / timed-out /
+        # terminated) and the failed-cancel resolution are on the
+        # transition surface the static checker says the kernel handles
+        [F.decision_task_scheduled(12, V, t + 5 * SECOND)],
+        [F.decision_task_started(13, V, t + 5 * SECOND, scheduled_event_id=12)],
+        [
+            F.decision_task_completed(14, V, t + 6 * SECOND, scheduled_event_id=12,
+                                      started_event_id=13),
+            F.start_child_initiated(15, V, t + 6 * SECOND, domain="dom",
+                                    workflow_id="child-2",
+                                    decision_task_completed_event_id=14),
+            F.start_child_initiated(16, V, t + 6 * SECOND, domain="dom",
+                                    workflow_id="child-3",
+                                    decision_task_completed_event_id=14),
+            F.start_child_initiated(17, V, t + 6 * SECOND, domain="dom",
+                                    workflow_id="child-4",
+                                    decision_task_completed_event_id=14),
+            F.request_cancel_external_initiated(18, V, t + 6 * SECOND,
+                                                domain="dom",
+                                                workflow_id="gone-wf",
+                                                decision_task_completed_event_id=14),
+        ],
+        [F.child_execution_started(19, V, t + 7 * SECOND, initiated_event_id=15,
+                                   workflow_id="child-2", run_id="crun-2")],
+        [F.child_execution_failed(20, V, t + 8 * SECOND, initiated_event_id=15,
+                                  started_event_id=19)],
+        [F.child_execution_started(21, V, t + 8 * SECOND, initiated_event_id=16,
+                                   workflow_id="child-3", run_id="crun-3")],
+        [F.child_execution_timed_out(22, V, t + 9 * SECOND, initiated_event_id=16,
+                                     started_event_id=21)],
+        [F.child_execution_started(23, V, t + 9 * SECOND, initiated_event_id=17,
+                                   workflow_id="child-4", run_id="crun-4")],
+        [F.child_execution_terminated(24, V, t + 10 * SECOND, initiated_event_id=17,
+                                      started_event_id=23)],
+        [F.request_cancel_external_failed(25, V, t + 10 * SECOND,
+                                          initiated_event_id=18)],
+    ]
+
+
+def continued_as_new_batches(t=T0):
+    """First run of a continued-as-new chain. NOT in ALL_SCENARIOS:
+    the oracle needs the new run's history threaded through
+    apply_events, which the shared assert_parity helper doesn't do —
+    TestTransitionCoverage replays it through its own parity check."""
+    return [
+        [F.workflow_execution_started(1, V, t, task_list="tl",
+                                      workflow_type="loop")],
+        [F.decision_task_scheduled(2, V, t)],
+        [F.decision_task_started(3, V, t + SECOND, scheduled_event_id=2)],
+        [
+            F.decision_task_completed(4, V, t + 2 * SECOND,
+                                      scheduled_event_id=2,
+                                      started_event_id=3),
+            F.workflow_execution_continued_as_new(
+                5, V, t + 2 * SECOND, new_execution_run_id="run-next",
+                decision_task_completed_event_id=4),
+        ],
     ]
 
 
@@ -422,3 +480,58 @@ class TestPackValidation:
         arr, side = pack_workflow(batches, S.Capacities())
         # a3 reuses slot 0 (lowest free)
         assert side.activity_ids == {0: "a3", 1: "a2"}
+
+
+class TestTransitionCoverage:
+    """Close the loop between the static transition surface
+    (cadence_tpu/analysis --emit-matrix) and the dynamic suites: every
+    event type the kernel claims to handle must actually occur in the
+    histories these tests generate, or the differential fuzz only
+    *samples* the surface the checker *covers*."""
+
+    def test_continued_as_new_parity(self):
+        """CaN is kernel-handled but needs new-run history on the
+        oracle side, so it gets its own parity check (the shared
+        assert_parity helper can't thread the new run through)."""
+        batches = continued_as_new_batches()
+        ms = MutableState(domain_id="dom")
+        ms.version_histories = VersionHistories.new_empty()
+        sb = StateBuilder(ms, id_generator=lambda: "fixed")
+        new_run = [F.workflow_execution_started(
+            1, V, T0 + 2 * SECOND, task_list="tl", workflow_type="loop")]
+        for batch in batches[:-1]:
+            sb.apply_events("dom", "req", "wf-can", "run-can", list(batch))
+        sb.apply_events(
+            "dom", "req", "wf-can", "run-can", list(batches[-1]), new_run
+        )
+        packed = pack_histories([("wf-can", "run-can", batches)])
+        final = replay_packed(packed)
+        got = state_row_to_snapshot(final, 0, packed.epoch_s)
+        want = mutable_state_to_snapshot(ms)
+        assert got == want
+
+    def test_generated_mix_covers_kernel_surface(self):
+        from cadence_tpu.analysis.transition_surface import (
+            kernel_handled_types,
+        )
+        from cadence_tpu.core.enums import EventType
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        seen = set()
+        for seed in (1, 2, 3):
+            fz = HistoryFuzzer(seed=seed)
+            for i in range(25):
+                for batch in fz.generate(target_events=10 + (i * 7) % 50):
+                    for ev in batch:
+                        seen.add(int(ev.event_type))
+        for fn in ALL_SCENARIOS + [continued_as_new_batches]:
+            for batch in fn():
+                for ev in batch:
+                    seen.add(int(ev.event_type))
+        handled = kernel_handled_types()
+        missing = sorted(EventType(t).name for t in handled - seen)
+        assert not missing, (
+            "kernel-handled event types never generated by the "
+            f"differential suites: {missing} — extend the fuzzer or a "
+            "scenario so the dynamic tests exercise the whole surface"
+        )

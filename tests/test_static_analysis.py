@@ -1,0 +1,537 @@
+"""Tests for the static-analysis gate (cadence_tpu/analysis).
+
+Two halves:
+
+* **known-bad fixtures** — per pass, a minimal snippet that violates
+  each rule, proving the rule actually fires (a lint that never fires
+  is indistinguishable from no lint);
+* **clean-tree gate** — running all three passes over this repository
+  yields zero non-baselined findings. This is the tier-1 embodiment of
+  the CI gate (scripts/run_lint.sh is the standalone wrapper).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from cadence_tpu.analysis import Baseline, BaselineEntry, Finding, run_all
+from cadence_tpu.analysis import jit_hazards, lock_order, transition_surface
+from cadence_tpu.analysis.findings import dedupe
+from cadence_tpu.analysis import oracle_ast
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------------------
+# baseline plumbing
+# --------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def test_exact_and_wildcard_matching(self):
+        bl = Baseline([
+            BaselineEntry("R1", "mod.py:Class.m:_lock:io", "known"),
+            BaselineEntry("R2", "mod.py:Class.*", "family"),
+        ])
+        fs = [
+            Finding("R1", "mod.py:Class.m:_lock:io", "x"),
+            Finding("R2", "mod.py:Class.other:_lock:io", "y"),
+            Finding("R1", "mod.py:Class.NEW:_lock:io", "z"),  # new
+        ]
+        new, accepted, stale = bl.split(fs)
+        assert [f.anchor for f in new] == ["mod.py:Class.NEW:_lock:io"]
+        assert len(accepted) == 2 and not stale
+
+    def test_stale_entries_reported(self):
+        bl = Baseline([BaselineEntry("R1", "gone:*", "fixed long ago")])
+        new, accepted, stale = bl.split([])
+        assert not new and not accepted and len(stale) == 1
+
+    def test_round_trip(self, tmp_path):
+        p = str(tmp_path / "bl.json")
+        Baseline([BaselineEntry("R", "a:*", "j")]).save(p)
+        loaded = Baseline.load(p)
+        assert loaded.entries[0].anchor == "a:*"
+        assert loaded.entries[0].justification == "j"
+
+
+# --------------------------------------------------------------------------
+# pass 1 — transition surface
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def surface():
+    """(kernel matrix, oracle table, pack handled) over the real tree —
+    traced once per test module, shared by the fixture tests."""
+    return transition_surface.build(REPO_ROOT)
+
+
+class TestTransitionSurface:
+    def test_schema_invariants_clean(self):
+        assert transition_surface.check_column_groups() == []
+
+    def test_duplicate_column_fires(self):
+        ns = {"EV_A": 0, "EV_B": 0, "EV_N": 1}
+        fs = transition_surface.check_column_groups(
+            {**{c: 0 for _, c in transition_surface.COLUMN_GROUPS}, **ns}
+        )
+        assert any(
+            f.rule == "SCHEMA-COLUMNS" and "EV_A" in f.message for f in fs
+        )
+
+    def test_gap_and_range_fire(self):
+        base = {c: 0 for _, c in transition_surface.COLUMN_GROUPS}
+        ns = {**base, "X_N": 3, "X_A": 0, "X_B": 5}
+        fs = transition_surface.check_column_groups(ns)
+        assert any("outside" in f.message for f in fs)          # X_B=5
+        assert any("not dense" in f.message or "no constant"
+                   in f.message for f in fs)                    # 1,2 missing
+
+    def test_pack_attr_window_fires(self):
+        src = textwrap.dedent("""
+            def pack_workflow(batches):
+                attrs = [0] * 8
+                attrs[3] = 1
+                attrs[9] = 2
+        """)
+        fs = transition_surface.check_pack_attrs(src)
+        assert [f.rule for f in fs] == ["SCHEMA-PACK-ATTR"]
+        assert "attrs[9]" in fs[0].message
+
+    def test_unhandled_type_fires(self, surface):
+        kmat, _, _, _ = surface
+        # MarkerRecorded has no kernel block; claim the oracle writes
+        # device state for it → the checker must flag the gap
+        fake = {
+            "MarkerRecorded": transition_surface.OracleEntry(
+                handlers=("replicate_marker",), is_noop=False,
+                tables={"timers"}, exec_cols=set(), unmapped_fields=set(),
+            )
+        }
+        fs = transition_surface.diff_surface(kmat, fake)
+        assert any(f.rule == "SURFACE-UNHANDLED" for f in fs)
+
+    def test_dead_block_fires(self, surface):
+        kmat, _, _, _ = surface
+        # empty oracle table → every kernel block is dead
+        fs = transition_surface.diff_surface(kmat, {})
+        dead = [f for f in fs if f.rule == "SURFACE-DEAD-BLOCK"]
+        assert len(dead) == len(kmat.handled_types())
+
+    def test_mask_mismatch_fires(self, surface):
+        kmat, otable, _, _ = surface
+        # claim TimerStarted touches children instead of timers
+        fake = dict(otable)
+        fake["TimerStarted"] = transition_surface.OracleEntry(
+            handlers=("replicate_timer_started_event",), is_noop=False,
+            tables={"children"}, exec_cols=set(), unmapped_fields=set(),
+        )
+        fs = transition_surface.diff_surface(kmat, fake)
+        anchors = {f.anchor for f in fs}
+        assert "surface:TimerStarted:extra" in anchors     # kernel: timers
+        assert "surface:TimerStarted:missing" in anchors   # oracle: children
+
+    def test_ts_coverage_gap_fires(self, surface):
+        kmat, _, _, _ = surface
+        from cadence_tpu.ops import schema as S
+
+        ns = dict(vars(S))
+        # drop the timer-expiry column from the rebase set
+        ns["ROW_TS_COLS"] = {
+            k: tuple(c for c in v if (k, c) != ("timers", S.TI_EXPIRY_TS))
+            for k, v in S.ROW_TS_COLS.items()
+        }
+        fs = transition_surface.check_ts_coverage(kmat, ns)
+        assert any(
+            f.rule == "SURFACE-TS-UNCOVERED" and "TI_EXPIRY_TS" in f.anchor
+            for f in fs
+        )
+
+    def test_ts_stale_fires(self, surface):
+        kmat, _, _, _ = surface
+        from cadence_tpu.ops import schema as S
+
+        ns = dict(vars(S))
+        # declare a non-timestamp column epoch-bearing
+        ns["ROW_TS_COLS"] = {
+            **S.ROW_TS_COLS,
+            "children": (S.CH_POLICY,),
+        }
+        fs = transition_surface.check_ts_coverage(kmat, ns)
+        assert any(f.rule == "SURFACE-TS-STALE" for f in fs)
+
+    def test_kernel_matrix_sanity(self, surface):
+        kmat, otable, pack_handled, rel_ts = surface
+        from cadence_tpu.core.enums import EventType, NUM_EVENT_TYPES
+
+        handled = kmat.handled_types()
+        # the four deliberate device-no-ops are the only unhandled types
+        unhandled = {
+            EventType(t).name
+            for t in range(NUM_EVENT_TYPES) if t not in handled
+        }
+        assert unhandled == {
+            "RequestCancelActivityTaskFailed", "CancelTimerFailed",
+            "MarkerRecorded", "UpsertWorkflowSearchAttributes",
+        }
+        # pack accepts everything the oracle replays
+        assert set(otable) <= pack_handled
+        # the traced matrix sees through the packer: wf expiration rides
+        # EV_A4 (rel_ts) into X_WF_EXPIRATION_TS
+        assert rel_ts.get("WorkflowExecutionStarted") == {4}
+        started = next(
+            g for g in kmat.groups
+            if g.types == (int(EventType.WorkflowExecutionStarted),)
+        )
+        assert "exec:X_WF_EXPIRATION_TS" in started.ts_cols
+        assert "exec:X_START_TS" in started.ts_cols
+
+    def test_oracle_ast_extraction(self):
+        src = textwrap.dedent("""
+            def apply_events(self, history):
+                for event in history:
+                    et = event.event_type
+                    if et == EventType.TimerStarted:
+                        ms.replicate_timer_started_event(event)
+                    elif et in (EventType.TimerFired, EventType.TimerCanceled):
+                        ms.replicate_timer_closed(event)
+                    elif et == EventType.MarkerRecorded:
+                        pass
+                    else:
+                        raise ValueError
+        """)
+        table = oracle_ast.extract_event_dispatch(src)
+        assert table["TimerStarted"].handler_calls == (
+            "replicate_timer_started_event",
+        )
+        assert table["TimerFired"].handler_calls == ("replicate_timer_closed",)
+        assert table["MarkerRecorded"].is_noop
+        assert "WorkflowExecutionStarted" not in table
+
+    def test_replicate_write_closure(self):
+        src = textwrap.dedent("""
+            class MutableState:
+                def _helper(self):
+                    self.execution_info.state = 1
+                    del self.pending_timers[0]
+                def replicate_x(self, event):
+                    ei = self.execution_info
+                    ei.signal_count += 1
+                    self._helper()
+        """)
+        writes = oracle_ast.extract_replicate_writes(src)
+        ws = writes["replicate_x"]
+        assert ws.exec_fields == {"signal_count", "state"}
+        assert ws.tables == {"timers"}
+
+    def test_emit_matrix_artifact(self, tmp_path):
+        path = str(tmp_path / "matrix.json")
+        transition_surface.emit_matrix(REPO_ROOT, path)
+        doc = json.load(open(path))
+        assert doc["groups"] and doc["oracle"]
+        assert "WorkflowExecutionStarted" in doc["kernel_handled_types"]
+        assert "exec:X_NEXT_EVENT_ID" in doc["common"]
+
+
+# --------------------------------------------------------------------------
+# pass 2 — jit hazards
+# --------------------------------------------------------------------------
+
+
+class TestJitHazards:
+    def test_host_sync_fixtures_fire(self):
+        src = textwrap.dedent("""
+            import jax, jax.numpy as jnp, numpy as np
+
+            def step(state, ev):
+                x = state[0].item()
+                y = float(ev[0])
+                z = np.asarray(state[1])
+                return state
+
+            step_jit = jax.jit(step)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        sync = [f for f in fs if f.rule == "JIT-HOST-SYNC"]
+        kinds = {f.anchor.rsplit(":", 1)[-1] for f in sync}
+        assert {"item", "float", "np.asarray"} <= kinds
+
+    def test_py_branch_fixture_fires(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def step(state, ev):
+                if ev[0] > 0:
+                    state = state
+                return state
+
+            step_jit = jax.jit(step)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert any(f.rule == "JIT-PY-BRANCH" for f in fs)
+
+    def test_none_checks_stay_legal(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def step(state, mask):
+                if mask is not None:
+                    state = state
+                return state
+
+            step_jit = jax.jit(step)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert not any(f.rule == "JIT-PY-BRANCH" for f in fs)
+
+    def test_unrounded_shape_fixture_fires(self):
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def drive(histories):
+                state = jnp.zeros((len(histories), 16))
+                return replay_scan_jit(state)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert any(f.rule == "JIT-SHAPE-ROUND" for f in fs)
+
+    def test_rounded_shape_passes(self):
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def drive(histories):
+                state = jnp.zeros((round_scan_len(len(histories)), 16))
+                return replay_scan_jit(state)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert not any(f.rule == "JIT-SHAPE-ROUND" for f in fs)
+
+    def test_narrow_force_wide_fixture_fires(self):
+        src = textwrap.dedent("""
+            def pack(teb):
+                return narrow_events_teb(teb)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert [f.rule for f in fs] == ["JIT-NARROW-FORCE-WIDE"]
+
+    def test_traced_function_discovery(self):
+        src = textwrap.dedent("""
+            import jax
+
+            def leaf(x):
+                return x
+
+            def root(x):
+                return leaf(x)
+
+            def host(x):
+                return root_jit(x)
+
+            root_jit = jax.jit(root, donate_argnums=(0,))
+        """)
+        import ast as astmod
+
+        traced = jit_hazards.traced_functions(astmod.parse(src))
+        assert traced == {"root", "leaf"}
+
+    def test_dtype_widen_fires_on_float(self):
+        import jax
+        import numpy as np
+
+        def bad(x):
+            return x * 1.5  # promotes to float
+
+        closed = jax.make_jaxpr(bad)(np.zeros((2,), np.int32))
+        fs = jit_hazards.trace_dtype_findings(closed, "fix:bad")
+        assert any(f.rule == "JIT-DTYPE-WIDEN" for f in fs)
+
+    def test_real_step_stays_int32(self):
+        assert jit_hazards.check_step_dtypes() == []
+
+
+# --------------------------------------------------------------------------
+# pass 3 — lock order
+# --------------------------------------------------------------------------
+
+
+def _lock_findings(src: str):
+    classes = lock_order.analyze_module(src, "fix.py")
+    return lock_order.collect_findings(classes)
+
+
+class TestLockOrder:
+    def test_sleep_under_lock_fires(self):
+        src = textwrap.dedent("""
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+        """)
+        fs = _lock_findings(src)
+        assert any(
+            f.rule == "LOCK-BLOCKING" and "sleep" in f.message for f in fs
+        )
+
+    def test_store_io_under_lock_fires(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self):
+                    with self._lock:
+                        self.persistence.shard.update_shard(1)
+        """)
+        fs = _lock_findings(src)
+        assert any(f.rule == "LOCK-BLOCKING" for f in fs)
+
+    def test_store_receiver_chain_fires_without_known_method(self):
+        # the method name is NOT in STORE_METHODS; the receiver chain
+        # naming a persistence manager must be enough
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self):
+                    with self._lock:
+                        self.persistence.workflow.load_everything(1)
+        """)
+        fs = _lock_findings(src)
+        assert any(
+            f.rule == "LOCK-BLOCKING" and "load_everything" in f.message
+            for f in fs
+        )
+
+    def test_inversion_fires(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        fs = _lock_findings(src)
+        assert any(f.rule == "LOCK-INVERSION" for f in fs)
+
+    def test_consistent_order_passes(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        fs = _lock_findings(src)
+        assert not any(f.rule == "LOCK-INVERSION" for f in fs)
+
+    def test_trylock_exempt(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def ok(self, other):
+                    with self._lock:
+                        if other.lock.acquire(blocking=False):
+                            other.lock.release()
+        """)
+        assert _lock_findings(src) == []
+
+    def test_wait_on_held_condition_exempt(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                def ok(self):
+                    with self._cond:
+                        self._cond.wait(1.0)
+                def bad(self, event):
+                    with self._cond:
+                        event.wait(1.0)
+        """)
+        fs = _lock_findings(src)
+        assert len(fs) == 1 and "ok" not in fs[0].anchor
+        assert "C.bad" in fs[0].anchor
+
+    def test_blocking_via_self_call_propagates(self):
+        src = textwrap.dedent("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def _persist(self):
+                    self.persistence.shard.update_shard(1)
+                def bad(self):
+                    with self._lock:
+                        self._persist()
+        """)
+        fs = _lock_findings(src)
+        assert any("C.bad" in f.anchor and "_persist" in f.anchor for f in fs)
+
+
+# --------------------------------------------------------------------------
+# the gate: clean tree against the checked-in baseline
+# --------------------------------------------------------------------------
+
+
+class TestCleanTreeGate:
+    def test_zero_new_findings(self):
+        baseline = Baseline.load(
+            os.path.join(REPO_ROOT, "config", "lint_baseline.json")
+        )
+        by_pass = run_all(REPO_ROOT)
+        all_findings = dedupe(
+            [f for fs in by_pass.values() for f in fs]
+        )
+        new, accepted, stale = baseline.split(all_findings)
+        assert not new, (
+            "non-baselined static-analysis findings (fix them or add a "
+            "justified baseline entry in config/lint_baseline.json):\n"
+            + "\n".join(f.format() for f in new)
+        )
+        # stale entries warn, matching the CLI contract ("a fixed
+        # finding shouldn't break the build") — clean them up when seen
+        for e in stale:
+            import warnings
+
+            warnings.warn(
+                f"stale lint baseline entry [{e.rule}] {e.anchor} — the "
+                "finding it accepts no longer exists; remove it from "
+                "config/lint_baseline.json"
+            )
