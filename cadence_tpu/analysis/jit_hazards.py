@@ -73,26 +73,36 @@ def _callee_name(node: ast.expr) -> Optional[str]:
     return None
 
 
+def _pallas_call_roots(tree: ast.Module) -> Set[str]:
+    """Function names handed to ``pallas_call(kernel_or_partial, ...)``
+    — the one extraction both the traced-fn rules and the int16
+    arithmetic rule scope from, so a new spelling lands in both."""
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = (
+            node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        )
+        if fname == "pallas_call" and node.args:
+            name = _callee_name(node.args[0])
+            if name:
+                roots.add(name)
+    return roots
+
+
 def traced_functions(tree: ast.Module) -> Set[str]:
     """Module-level function names whose bodies run at trace time."""
     fns: Dict[str, ast.FunctionDef] = {
         n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
     }
-    roots: Set[str] = set()
+    roots: Set[str] = set(_pallas_call_roots(tree))
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
             # X = jax.jit(f, ...)
             if _is_jax_jit(node.func) and node.args:
-                name = _callee_name(node.args[0])
-                if name:
-                    roots.add(name)
-            # pallas_call(kernel_or_partial, ...)
-            fname = (
-                node.func.attr
-                if isinstance(node.func, ast.Attribute)
-                else node.func.id if isinstance(node.func, ast.Name) else ""
-            )
-            if fname == "pallas_call" and node.args:
                 name = _callee_name(node.args[0])
                 if name:
                     roots.add(name)
@@ -308,6 +318,132 @@ def _lint_narrow_force_wide(
             ))
 
 
+def pallas_kernels(tree: ast.Module) -> Set[str]:
+    """Function names handed to ``pallas_call`` (+ same-module call
+    closure) — the scope of the int16 arithmetic rule."""
+    fns: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+    }
+    roots = {n for n in _pallas_call_roots(tree) if n in fns}
+    frontier = list(roots)
+    while frontier:
+        cur = frontier.pop()
+        for node in ast.walk(fns[cur]):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = node.func.id
+                if callee in fns and callee not in roots:
+                    roots.add(callee)
+                    frontier.append(callee)
+    return roots
+
+
+def _is_dtype(node: ast.expr, name: str) -> bool:
+    """jnp.int16 / np.int16 / "int16" / int16 spellings."""
+    if isinstance(node, ast.Attribute) and node.attr == name:
+        return True
+    if isinstance(node, ast.Name) and node.id == name:
+        return True
+    return isinstance(node, ast.Constant) and node.value == name
+
+
+def _is_astype(node: ast.AST, dtype: str) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and bool(node.args)
+        and _is_dtype(node.args[0], dtype)
+    )
+
+
+def _lint_int16_arith(
+    fn: ast.FunctionDef, relpath: str, findings: List[Finding]
+) -> None:
+    """PALLAS-INT16-ARITH — narrow-stream values must widen before
+    multiply/accumulate.
+
+    int16 multiplies (and long adds) wrap silently on the VPU: the
+    narrow event stream is a transfer/HBM format, never an arithmetic
+    one, so every value cast (or loaded) as int16 must pass through
+    ``.astype(jnp.int32)`` before feeding ``*``/``+``/``-``. Flags any
+    binary arithmetic or augmented assignment whose operand is an int16
+    cast, or a local name whose latest cast-assignment above the use is
+    one (line-ordered, so re-narrowing after a widen is still caught)."""
+    # name -> line-sorted [(lineno, is_narrow)]; a use consults the
+    # latest assignment at-or-above its own line, not a whole-function
+    # set (x = a.astype(int32) ... x = b.astype(int16); out = x * 3
+    # must flag)
+    assigns: dict = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        }
+        if not names:
+            continue
+        if any(_is_astype(n, "int32") for n in ast.walk(node.value)):
+            is_narrow = False
+        elif any(_is_astype(n, "int16") for n in ast.walk(node.value)):
+            is_narrow = True
+        else:
+            continue
+        for nm in names:
+            assigns.setdefault(nm, []).append((node.lineno, is_narrow))
+    for lst in assigns.values():
+        lst.sort()
+
+    def _name_narrow_at(name: str, use_line: int) -> bool:
+        state = False
+        for ln, is_narrow in assigns.get(name, ()):
+            if ln > use_line:
+                break
+            state = is_narrow
+        return state
+
+    def is_narrow_operand(side: ast.expr, use_line: int) -> bool:
+        if isinstance(side, ast.Name) and _name_narrow_at(
+            side.id, use_line
+        ):
+            return True
+        # a bare cast used inline, or any int16 cast inside the operand
+        # expression that is not re-widened above it
+        sub = list(ast.walk(side))
+        return any(_is_astype(n, "int16") for n in sub) and not any(
+            _is_astype(n, "int32") for n in sub
+        )
+
+    seen_lines: Set[int] = set()
+    for node in ast.walk(fn):
+        operands = ()
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Mult, ast.Add, ast.Sub)
+        ):
+            operands = (node.left, node.right)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.op, (ast.Mult, ast.Add, ast.Sub)
+        ):
+            tgt = node.target
+            operands = (tgt, node.value) if isinstance(tgt, ast.Name) \
+                else (node.value,)
+        for side in operands:
+            if (
+                is_narrow_operand(side, node.lineno)
+                and node.lineno not in seen_lines
+            ):
+                seen_lines.add(node.lineno)
+                findings.append(Finding(
+                    "PALLAS-INT16-ARITH",
+                    f"{relpath}:{fn.name}:int16#{len(seen_lines)}",
+                    f"{relpath}:{node.lineno}: int16-narrow value feeds "
+                    f"multiply/accumulate in Pallas kernel {fn.name} "
+                    "without .astype(jnp.int32) — int16 arithmetic "
+                    "wraps silently on the VPU; widen the narrow "
+                    "stream before any arithmetic",
+                ))
+                break
+
+
 def _jit_entry_names(tree: ast.Module) -> Set[str]:
     out: Set[str] = set()
     for node in tree.body:
@@ -324,6 +460,7 @@ def lint_source(source: str, relpath: str) -> List[Finding]:
     tree = ast.parse(source)
     findings: List[Finding] = []
     traced = traced_functions(tree)
+    kernels = pallas_kernels(tree)
     jit_entries = _jit_entry_names(tree)
     for node in tree.body:
         if not isinstance(node, ast.FunctionDef):
@@ -332,6 +469,8 @@ def lint_source(source: str, relpath: str) -> List[Finding]:
             _lint_traced_fn(node, relpath, findings)
         else:
             _lint_shape_round(node, relpath, jit_entries, findings)
+        if node.name in kernels:
+            _lint_int16_arith(node, relpath, findings)
     # methods of classes (dispatch pumps) get the shape rule too
     for node in tree.body:
         if isinstance(node, ast.ClassDef):

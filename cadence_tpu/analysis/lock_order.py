@@ -366,7 +366,8 @@ def collect_findings(classes: List[ClassAnalysis]) -> List[Finding]:
     return findings
 
 
-SCOPE_DIRS = ("cadence_tpu/runtime", "cadence_tpu/checkpoint")
+SCOPE_DIRS = ("cadence_tpu/runtime", "cadence_tpu/checkpoint",
+              "cadence_tpu/matching")
 
 
 def run(repo_root: str) -> List[Finding]:

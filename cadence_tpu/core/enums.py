@@ -230,6 +230,30 @@ class TaskListType(enum.IntEnum):
     Activity = 1
 
 
+# Workflow close event type -> CloseStatus recorded on X_CLOSE_STATUS:
+# the single source of truth every replay kernel (sequential XLA scan,
+# Pallas, both associative evaluators in ops/assoc.py) derives its
+# close-status arithmetic from, so a new close type lands in all of
+# them at once instead of four hand-kept copies.
+WORKFLOW_CLOSE_STATUS = (
+    (EventType.WorkflowExecutionCompleted, CloseStatus.Completed),
+    (EventType.WorkflowExecutionFailed, CloseStatus.Failed),
+    (EventType.WorkflowExecutionTimedOut, CloseStatus.TimedOut),
+    (EventType.WorkflowExecutionCanceled, CloseStatus.Canceled),
+    (EventType.WorkflowExecutionTerminated, CloseStatus.Terminated),
+    (EventType.WorkflowExecutionContinuedAsNew, CloseStatus.ContinuedAsNew),
+)
+
+
+def decision_attempt_increment(dfail, dto, a0):
+    """Which decision fail/timeout steps bump X_DEC_ATTEMPT — the oracle's
+    ``fail_decision`` precondition, shared by every replay kernel: a
+    DecisionTaskFailed always increments; a DecisionTaskTimedOut
+    increments unless its timeout type (``a0``) is ScheduleToStart.
+    Pure ``|``/``&``/``!=`` so numpy and jax bool masks both work."""
+    return dfail | (dto & (a0 != int(TimeoutType.ScheduleToStart)))
+
+
 # Activity timer-task dedup status bitmask, mirrors the reference's
 # TimerTaskStatus* bit flags (service/history/mutableStateBuilder.go).
 TIMER_TASK_STATUS_NONE = 0

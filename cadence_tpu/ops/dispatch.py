@@ -106,8 +106,27 @@ class DeviceDispatcher:
         tb: int = 16,
         lane_pack: bool = False,
         lane_len: Optional[int] = None,
+        scan_mode: str = "auto",
     ) -> None:
         self.caps = caps or S.Capacities()
+        # which time-axis kernel the run pump uses:
+        #   "scan"  — the sequential O(T)-depth kernels everywhere.
+        #   "assoc" — the parallel-in-time associative path
+        #             (ops/assoc.py) for both unpacked and lane-packed
+        #             batches (lane-packed falls back per batch when a
+        #             type is not provably affine).
+        #   "auto"  — assoc for unpacked XLA batches (scan depth is
+        #             their cost; retry_deep/ndc_storm are ~10x faster
+        #             on CPU), sequential for lane-packed ones (packing
+        #             already flattens depth to ~total/lanes, where the
+        #             assoc path's per-history provenance scatters lose)
+        #             and for the Pallas serving path on TPU.
+        if scan_mode not in ("auto", "scan", "assoc"):
+            raise ValueError(
+                "scan_mode must be 'auto', 'scan', or 'assoc' "
+                f"(got {scan_mode!r})"
+            )
+        self.scan_mode = scan_mode
         # threaded into pack_workflow: side-table target domains must
         # be RESOLVED ids, matching the host oracle (StateBuilder)
         self.domain_resolver = domain_resolver
@@ -214,8 +233,46 @@ class DeviceDispatcher:
             except Exception as e:
                 self._staged.put(DispatchError(batch_id, e))
 
+    def _assoc_enabled(self, use_pallas: bool) -> bool:
+        """Can any batch ride the associative kernels on this host?
+        Mirrors the serving facades' gate (replay_packed /
+        replay_packed_lanes): off-TPU only — a forced ``kernel="xla"``
+        on a TPU host must not route the never-TPU-validated assoc
+        kernel onto the TPU backend (the Pallas/TPU assoc path is an
+        open ROADMAP item)."""
+        if use_pallas or self.scan_mode == "scan":
+            return False
+        try:
+            import jax
+
+            return jax.default_backend() != "tpu"
+        except Exception:
+            return False
+
+    def _assoc_hist(self, use_pallas: bool, present) -> bool:
+        """Should this unpacked batch ride the associative kernel?
+        ``present`` is THIS batch's type set, not the monotone
+        ``_type_set`` — one batch carrying a (future) non-affine type
+        must not downgrade every later affine batch in the stream."""
+        if not self._assoc_enabled(use_pallas):
+            return False
+        from .replay import assoc_classify_types
+
+        _, non = assoc_classify_types(present)
+        return not non
+
+    def _assoc_lanes(self, use_pallas: bool, present) -> bool:
+        if self.scan_mode != "assoc" or not self._assoc_enabled(use_pallas):
+            return False
+        from .replay import assoc_classify_types
+
+        _, non = assoc_classify_types(present)
+        return not non
+
     def _pack_hist_item(self, batch_id, histories, use_pallas, jax, jnp,
                         resume=None):
+        import numpy as _np
+
         from .pack import pack_histories
 
         b = len(histories)
@@ -226,6 +283,33 @@ class DeviceDispatcher:
             domain_resolver=self.domain_resolver,
             resume=resume,
         )
+        # present-type scan is a full [B, T] host pass; skip it when the
+        # assoc path is statically off (scan/pallas/TPU backend) —
+        # _assoc_hist would ignore the result and the "hist" branch
+        # replays unspecialized
+        present = None
+        if self._assoc_enabled(use_pallas):
+            present = [
+                int(t)
+                for t in _np.unique(packed.events[:, :, S.EV_TYPE])
+                if t >= 0
+            ]
+            self._type_set.update(present)
+        if present is not None and self._assoc_hist(use_pallas, present):
+            from .assoc import events_fm_of
+            from .replay import type_signature
+
+            # field-major column planes — the assoc kernel's operand
+            # layout; built host-side so the copy overlaps device work
+            events = jax.device_put(
+                jnp.asarray(events_fm_of(packed.events)))
+            state0 = jax.tree_util.tree_map(
+                jnp.asarray,
+                packed.initial if packed.initial is not None
+                else S.empty_state(packed.batch, self.caps),
+            )
+            sig = type_signature(self._type_set)
+            return ("hist_assoc", batch_id, packed, events, state0, sig, b)
         narrow_meta = None
         if use_pallas:
             teb = packed.teb()
@@ -267,6 +351,18 @@ class DeviceDispatcher:
         )
         self._type_set.update(packed.present_types)
         sig = type_signature(self._type_set)
+        if self._assoc_lanes(use_pallas, packed.present_types):
+            from .assoc import assoc_lanes_operands, events_fm_of
+
+            init, hist_bm, seg_pos, seg_lane, seg_start = (
+                assoc_lanes_operands(packed))
+            arrays = (
+                jax.device_put(jnp.asarray(events_fm_of(packed.events))),
+                jnp.asarray(hist_bm), jnp.asarray(seg_pos),
+                jnp.asarray(seg_lane), jnp.asarray(seg_start),
+            )
+            init = jax.tree_util.tree_map(jnp.asarray, init)
+            return ("lanes_assoc", batch_id, packed, arrays, init, sig)
         narrow_meta = None
         if use_pallas:
             teb = packed.teb()
@@ -333,7 +429,32 @@ class DeviceDispatcher:
                 continue
             mode, batch_id = item[0], item[1]
             try:
-                if mode == "lanes":
+                if mode == "hist_assoc":
+                    _, _, packed, events, state0, sig, b = item
+                    from .assoc import _assoc_core
+
+                    final = _assoc_core(events, state0, types=sig)
+                    if b < packed.batch:
+                        import jax
+
+                        final = jax.tree_util.tree_map(
+                            lambda x: x[:b], final
+                        )
+                elif mode == "lanes_assoc":
+                    _, _, packed, arrays, init, sig = item
+                    from .assoc import _assoc_core
+
+                    evf, hist_bm, seg_pos, seg_lane, seg_start = arrays
+                    final = _assoc_core(
+                        evf, init, hist_bm, seg_pos, seg_lane,
+                        seg_start, types=sig,
+                    )
+                    import jax
+
+                    final = jax.tree_util.tree_map(
+                        lambda x: x[: packed.n_histories], final
+                    )
+                elif mode == "lanes":
                     (_, _, packed, arrays, state0, out0, sig,
                      narrow_meta, resume_extra) = item
                     if use_pallas:
@@ -476,6 +597,7 @@ def replay_stream(
     lane_len: Optional[int] = None,
     bucket: bool = False,
     resume: Optional[Sequence] = None,
+    scan_mode: str = "auto",
 ) -> List[Tuple]:
     """Replay a large history stream through the pipelined dispatcher.
 
@@ -502,7 +624,7 @@ def replay_stream(
     if bucket:
         d = DeviceDispatcher(
             caps=caps, depth=depth, kernel=kernel, lane_pack=True,
-            lane_len=lane_len,
+            lane_len=lane_len, scan_mode=scan_mode,
         )
         n = 0
         for idxs, hs in depth_buckets(histories):
@@ -521,7 +643,7 @@ def replay_stream(
         return out
     d = DeviceDispatcher(
         caps=caps, depth=depth, kernel=kernel, lane_pack=lane_pack,
-        lane_len=lane_len,
+        lane_len=lane_len, scan_mode=scan_mode,
     )
     n = 0
     for i in range(0, len(histories), batch_size):

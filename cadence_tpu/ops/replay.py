@@ -37,7 +37,10 @@ from jax import lax
 
 import numpy as np
 
-from cadence_tpu.core.enums import CloseStatus, EventType as E, TimeoutType, WorkflowState
+from cadence_tpu.core.enums import (
+    CloseStatus, EventType as E, WorkflowState,
+    WORKFLOW_CLOSE_STATUS, decision_attempt_increment,
+)
 from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION
 
 from . import schema as S
@@ -90,6 +93,17 @@ def _type_groups():
              E.ExternalWorkflowExecutionSignaled),
         )
     return _TYPE_GROUPS
+
+
+def check_scan_mode(scan_mode: str, allowed=("auto", "scan", "assoc")):
+    """Reject unknown ``scan_mode`` strings up front: the kernel
+    selectors otherwise each read the string differently, so a typo
+    ("asoc", "Scan") would silently pick a kernel instead of erroring."""
+    if scan_mode not in allowed:
+        raise ValueError(
+            f"scan_mode must be one of {'/'.join(allowed)} "
+            f"(got {scan_mode!r})"
+        )
 
 
 def type_signature(present) -> tuple:
@@ -233,7 +247,14 @@ def replay_step_cols(cols, ev: jnp.ndarray, types: Optional[tuple] = None):
     # ---- version-history add_or_update (versionHistory.go AddOrUpdateItem)
     cap_v = vh_v.shape[1]
     last_idx = jnp.maximum(vh_len - 1, 0)
-    last_ver = jnp.take_along_axis(vh_v, last_idx[:, None], axis=1)[:, 0]
+    # read the last *materialized* slot: past capacity, last_idx exceeds
+    # the table and an unclamped gather's out-of-bounds semantics are
+    # backend-defined; the clamped read keeps overflowed states (chained
+    # bench iterations, not real histories) deterministic and identical
+    # across the scan / Pallas / assoc kernels. write_idx keeps the raw
+    # last_idx so same-version writes past capacity still match no slot.
+    last_ver = jnp.take_along_axis(
+        vh_v, jnp.minimum(last_idx, cap_v - 1)[:, None], axis=1)[:, 0]
     same = (vh_len > 0) & (last_ver == version)
     write_idx = jnp.where(same, last_idx, jnp.minimum(vh_len, cap_v - 1))
     wmask = valid[:, None] & (write_idx[:, None] == jnp.arange(cap_v)[None, :])
@@ -261,14 +282,7 @@ def replay_step_cols(cols, ev: jnp.ndarray, types: Optional[tuple] = None):
         xset(col, m_start, 0)
 
     close_terms = []
-    for t, cs in (
-        (E.WorkflowExecutionCompleted, CloseStatus.Completed),
-        (E.WorkflowExecutionFailed, CloseStatus.Failed),
-        (E.WorkflowExecutionTimedOut, CloseStatus.TimedOut),
-        (E.WorkflowExecutionCanceled, CloseStatus.Canceled),
-        (E.WorkflowExecutionTerminated, CloseStatus.Terminated),
-        (E.WorkflowExecutionContinuedAsNew, CloseStatus.ContinuedAsNew),
-    ):
+    for t, cs in WORKFLOW_CLOSE_STATUS:
         mk = m(t)
         if mk is not None:
             close_terms.append((mk, int(cs)))
@@ -325,7 +339,7 @@ def replay_step_cols(cols, ev: jnp.ndarray, types: Optional[tuple] = None):
         fill = jnp.zeros_like(valid)
         dto = fill if m_dto is None else m_dto
         dfail = fill if m_dfail is None else m_dfail
-        increment = dfail | (dto & (a0 != int(TimeoutType.ScheduleToStart)))
+        increment = decision_attempt_increment(dfail, dto, a0)
         no_increment = (dto | dfail) & ~increment
         # transient decision fires iff attempt was incremented (oracle:
         # replicate_transient_decision_task_scheduled precondition
@@ -668,6 +682,7 @@ replay_scan_packed_jit = jax.jit(
 def replay_packed_lanes(
     packed: PackedLanes, specialize: bool = True,
     initial: Optional[S.StateTensors] = None,
+    scan_mode: str = "auto",
 ) -> S.StateTensors:
     """Replay a lane-packed batch; returns numpy state with one row per
     history, in input order (``packed.side`` indexes it directly).
@@ -678,12 +693,35 @@ def replay_packed_lanes(
     its row instead of ``empty_state``, bit-identically to replaying
     the full history from scratch.
 
+    ``scan_mode``: ``"scan"`` = the sequential O(T)-depth kernels;
+    ``"assoc"`` = the parallel-in-time associative path (ops/assoc.py,
+    segment resets ride the packer's segment table); ``"auto"`` picks
+    assoc off-TPU when every present type is provably affine — the
+    sequential scan otherwise. The lane-packed assoc path has no
+    per-event hybrid chunker, so a batch with a non-affine type falls
+    back to the sequential packed scan under BOTH ``"auto"`` and a
+    forced ``"assoc"``. On TPU every ``scan_mode`` rides the serving
+    kernels below (the Pallas/TPU assoc path is still an open item —
+    see ROADMAP).
+
     On TPU, lanes packed with ``seg_align`` a multiple of the Pallas
     time block ride the chunked VMEM-resident kernel
     (ops/replay_pallas.py replay_scan_pallas_packed); everywhere else —
     and for unaligned packings — the XLA scan handles arbitrary segment
     boundaries."""
+    check_scan_mode(scan_mode)
     caps = packed.caps
+    if scan_mode != "scan" and jax.default_backend() != "tpu":
+        from .assoc import classify_types, replay_assoc_lanes
+
+        _, non = classify_types(packed.present_types)
+        if not non:
+            # unspecialized on this facade: one compile per SHAPE. The
+            # per-type-set specialization only pays when a storm reuses
+            # one signature (the dispatcher grows a monotone set for
+            # exactly that); here it would recompile per batch.
+            return replay_assoc_lanes(
+                packed, initial=initial, specialize=False)
     if initial is None:
         initial = packed.initial
     n_pad = round_scan_len(packed.n_histories)
@@ -736,6 +774,7 @@ def replay_packed_lanes(
 def replay_packed(
     packed,
     initial: Optional[S.StateTensors] = None,
+    scan_mode: str = "auto",
 ) -> S.StateTensors:
     """Replay a packed batch on the default device; returns numpy state.
 
@@ -744,20 +783,65 @@ def replay_packed(
     history). On TPU the PackedHistories path rides the Pallas
     VMEM-resident kernel through the packer's field-major layout + host
     presence masks (the serving-path configuration bench.py measures);
-    elsewhere it uses the XLA scan — the two are bit-identical
-    (tests/test_replay_pallas.py). The XLA batch dimension is padded to
+    elsewhere the default (``scan_mode="auto"``) is the parallel-in-time
+    associative path (ops/assoc.py) whenever every present event type is
+    provably affine, falling back to the sequential XLA scan otherwise —
+    all paths are bit-identical (tests/test_fuzz_differential.py).
+    ``scan_mode="scan"`` forces the sequential kernels;
+    ``scan_mode="assoc"`` forces the associative one (hybrid-chunking
+    around any nonaffine steps) — off TPU only: on a TPU backend every
+    mode rides the Pallas/sequential serving path, the TPU assoc
+    benchmark being an open ROADMAP item. The XLA batch dimension is padded to
     the geometric shape grid (``round_scan_len``) so a storm of
     arbitrary batch sizes compiles a bounded set of executables."""
+    check_scan_mode(scan_mode)
     if isinstance(packed, PackedLanes):
         # initial: [n_histories] per-history resume carries (checkpoint
         # rows); defaults to packed.initial from pack_lanes(resume=...)
-        return replay_packed_lanes(packed, initial=initial)
+        return replay_packed_lanes(
+            packed, initial=initial, scan_mode=scan_mode)
     if initial is None:
         initial = packed.initial
     state = initial if initial is not None else S.empty_state(packed.batch, packed.caps)
     state = jax.tree_util.tree_map(jnp.asarray, state)
     if packed.batch == 0:
         return jax.tree_util.tree_map(np.asarray, state)
+    if scan_mode != "scan" and jax.default_backend() != "tpu":
+        from .assoc import (
+            classify_types, events_fm_of, replay_assoc, replay_assoc_fm,
+        )
+
+        present = [
+            int(t)
+            for t in np.unique(packed.events[:, :, S.EV_TYPE])
+            if t >= 0
+        ]
+        _, non = classify_types(present)
+        if scan_mode == "assoc" or not non:
+            b = packed.batch
+            bp = round_scan_len(b)
+            evf = events_fm_of(packed.events)
+            if bp > b:
+                pad = np.zeros((S.EV_N, bp - b, evf.shape[2]), np.int32)
+                pad[S.EV_TYPE] = -1
+                evf = np.concatenate([evf, pad], axis=1)
+                state = jax.tree_util.tree_map(
+                    lambda x, p: jnp.concatenate(
+                        [x, jnp.asarray(p)], axis=0
+                    ),
+                    state,
+                    S.empty_state(bp - b, packed.caps),
+                )
+            if non:
+                # hybrid: sequential steps only at nonaffine events
+                final = replay_assoc(state, events_fm=evf)
+            else:
+                # unspecialized: one compile per shape (see the lanes
+                # branch above)
+                final = replay_assoc_fm(state, evf)
+            if bp > b:
+                final = jax.tree_util.tree_map(lambda x: x[:b], final)
+            return jax.tree_util.tree_map(np.asarray, final)
     if jax.default_backend() == "tpu":
         from .replay_pallas import BT, replay_scan_pallas_teb
 
@@ -789,3 +873,12 @@ def replay_packed(
         if bp > b:
             final = jax.tree_util.tree_map(lambda x: x[:b], final)
     return jax.tree_util.tree_map(np.asarray, final)
+
+
+# Parallel-in-time entry points (ops/assoc.py): replay_assoc is the
+# chunked hybrid over an unpacked time-major tensor — associative
+# composition over affine runs, short sequential scans at any step the
+# classifier cannot prove affine. Re-exported here because replay.py is
+# the kernel facade the dispatcher and rebuild paths import from.
+from .assoc import replay_assoc  # noqa: E402,F401
+from .assoc import classify_types as assoc_classify_types  # noqa: E402,F401

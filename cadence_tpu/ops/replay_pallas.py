@@ -52,7 +52,10 @@ _CompilerParams = getattr(
     pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
 )
 
-from cadence_tpu.core.enums import CloseStatus, EventType as E, TimeoutType, WorkflowState
+from cadence_tpu.core.enums import (
+    CloseStatus, EventType as E, WorkflowState,
+    WORKFLOW_CLOSE_STATUS, decision_attempt_increment,
+)
 from cadence_tpu.core.ids import EMPTY_EVENT_ID, EMPTY_VERSION
 
 from . import schema as S
@@ -256,9 +259,14 @@ def _kernel(presence_ref, base_ref, ev_ref, init_ref, st, *, rm: RowMap,
         cap_v = caps.max_version_items
         vh_len = rd(rm.vhlen)
         last_idx = jnp.maximum(vh_len - 1, 0)
+        # clamped read of the last materialized slot (see replay.py:
+        # overflowed vh_len must compare against slot cap_v-1, not fall
+        # through to the zero init); write_idx keeps the raw last_idx so
+        # same-version writes past capacity still match no slot
+        read_idx = jnp.minimum(last_idx, cap_v - 1)
         last_ver = jnp.zeros_like(vh_len)
         for i_v in range(cap_v):
-            last_ver = jnp.where(last_idx == i_v, rd(rm.vh0 + 2 * i_v + 1),
+            last_ver = jnp.where(read_idx == i_v, rd(rm.vh0 + 2 * i_v + 1),
                                  last_ver)
         same = (vh_len > 0) & (last_ver == version)
         write_idx = jnp.where(same, last_idx,
@@ -294,21 +302,10 @@ def _kernel(presence_ref, base_ref, ev_ref, init_ref, st, *, rm: RowMap,
                         S.X_DEC_ORIGINAL_SCHEDULED_TS):
                 wr(X + col, m_start, 0)
 
-        @pl.when(present(
-            E.WorkflowExecutionCompleted, E.WorkflowExecutionFailed,
-            E.WorkflowExecutionTimedOut, E.WorkflowExecutionCanceled,
-            E.WorkflowExecutionTerminated,
-            E.WorkflowExecutionContinuedAsNew))
+        @pl.when(present(*(t for t, _ in WORKFLOW_CLOSE_STATUS)))
         def _():
-            close_status = (
-                m(E.WorkflowExecutionCompleted) * int(CloseStatus.Completed)
-                + m(E.WorkflowExecutionFailed) * int(CloseStatus.Failed)
-                + m(E.WorkflowExecutionTimedOut) * int(CloseStatus.TimedOut)
-                + m(E.WorkflowExecutionCanceled) * int(CloseStatus.Canceled)
-                + m(E.WorkflowExecutionTerminated)
-                * int(CloseStatus.Terminated)
-                + m(E.WorkflowExecutionContinuedAsNew)
-                * int(CloseStatus.ContinuedAsNew)
+            close_status = sum(
+                m(t) * int(cs) for t, cs in WORKFLOW_CLOSE_STATUS
             )
             m_close = close_status > 0
             wr(X + S.X_STATE, m_close, int(WorkflowState.Completed))
@@ -367,9 +364,7 @@ def _kernel(presence_ref, base_ref, ev_ref, init_ref, st, *, rm: RowMap,
         def _():
             m_dto = m(E.DecisionTaskTimedOut)
             m_dfail = m(E.DecisionTaskFailed)
-            increment = m_dfail | (
-                m_dto & (a0 != int(TimeoutType.ScheduleToStart))
-            )
+            increment = decision_attempt_increment(m_dfail, m_dto, a0)
             no_increment = (m_dto | m_dfail) & ~increment
             new_attempt = rd(X + S.X_DEC_ATTEMPT) + 1
             wr(X + S.X_DEC_VERSION, increment, rd(X + S.X_CUR_VERSION))
@@ -640,13 +635,56 @@ def narrow_events_teb(events_teb, force_wide=()):
     return out, base64.astype(np.int32), wide_cols
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("caps", "tb", "interpret", "bt",
-                                    "ablate", "wide_cols"))
 def _replay_rows_pallas(events_teb, rows0, caps: S.Capacities,
                         tb: int, interpret: bool, bt: int = BT,
                         ablate: int = 0, presence=None, base=None,
                         wide_cols: tuple = ()):
+    """Dispatch wrapper: concrete interpret-mode calls (the CPU parity
+    path — tests and CPU serving, never the TPU hot path) go through a
+    cached AOT lower/compile at XLA opt level 0. Interpret tracing +
+    optimizing the emulated kernel costs tens of seconds per call and
+    an eager invocation never hits the jit executable cache (fresh
+    closure identity each call); runtime of the emulated kernel is
+    negligible either way, so the optimizer pays for nothing."""
+    if interpret and not any(
+        isinstance(a, jax.core.Tracer)
+        for a in (events_teb, rows0, presence, base)
+    ):
+        args = (jnp.asarray(events_teb), jnp.asarray(rows0),
+                None if presence is None else jnp.asarray(presence),
+                None if base is None else jnp.asarray(base))
+        exe = _interp_rows_exec(
+            caps, tb, bt, ablate, tuple(wide_cols),
+            tuple(_avkey(a) for a in args))
+        return exe(*args)
+    return _replay_rows_pallas_jit(
+        events_teb, rows0, caps, tb, interpret, bt, ablate, presence,
+        base, tuple(wide_cols))
+
+
+def _avkey(x):
+    return None if x is None else (tuple(x.shape), x.dtype.name)
+
+
+@functools.lru_cache(maxsize=64)
+def _interp_rows_exec(caps, tb, bt, ablate, wide_cols, avkey):
+    avals = [
+        None if k is None else jax.ShapeDtypeStruct(k[0], k[1])
+        for k in avkey
+    ]
+    low = _replay_rows_pallas_jit.lower(
+        avals[0], avals[1], caps, tb, True, bt, ablate, avals[2],
+        avals[3], wide_cols)
+    return low.compile({"xla_backend_optimization_level": 0})
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("caps", "tb", "interpret", "bt",
+                                    "ablate", "wide_cols"))
+def _replay_rows_pallas_jit(events_teb, rows0, caps: S.Capacities,
+                            tb: int, interpret: bool, bt: int = BT,
+                            ablate: int = 0, presence=None, base=None,
+                            wide_cols: tuple = ()):
     """events_teb: [T, EV_N, B] int32 — or the int16 narrow stream from
     ``narrow_events_teb`` (physical layout, with ``base`` [EV_N] int32
     and the static ``wide_cols`` tuple); rows0: [R, B]. Returns [R, B].
@@ -925,13 +963,45 @@ def replay_scan_pallas_packed(
     ev_blocks = events_teb.reshape(nb, tb, ev_n, lb)
     seg_b = jnp.transpose(jnp.asarray(seg_end)[:, tb - 1 :: tb])  # [nb, lb]
     row_b = jnp.transpose(jnp.asarray(out_row)[:, tb - 1 :: tb])
+    # base normalized to a concrete vector: the kernel only reads it on
+    # the narrow path, and zeros reproduce the None default bit-for-bit
+    base_arr = (jnp.zeros((ev_n,), jnp.int32) if base is None
+                else jnp.asarray(base, jnp.int32))
+    args = (ev_blocks, rows0, out_rows0, seg_b, row_b, reset_b,
+            init_rows, base_arr)
+    if interpret and not any(isinstance(a, jax.core.Tracer) for a in args):
+        exe = _interp_packed_exec(
+            caps, tb, bt, tuple(wide_cols),
+            tuple(_avkey(jnp.asarray(a)) for a in args))
+        rows, out = exe(*args)
+    else:
+        rows, out = _packed_scan_core(
+            *args, caps=caps, tb=tb, bt=bt, interpret=interpret,
+            wide_cols=tuple(wide_cols))
+    return (
+        rows_to_state(rows[:, :L], rm),
+        rows_to_state(out, rm),
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("caps", "tb", "bt", "interpret",
+                                    "wide_cols"))
+def _packed_scan_core(ev_blocks, rows0, out_rows0, seg_b, row_b,
+                      reset_b, init_rows, base, *, caps, tb, bt,
+                      interpret, wide_cols):
+    """The packed block scan as one stable-identity jitted computation:
+    eager per-batch calls reuse the executable cache instead of
+    re-tracing a fresh closure every invocation (the serving pump calls
+    this once per lane-packed batch)."""
+    n_out = out_rows0.shape[1]
 
     def body(carry, xs):
         rows, out = carry
         evb, seg, orow, rrow = xs
         rows = _replay_rows_pallas(
             evb, rows, caps, tb, interpret, bt, base=base,
-            wide_cols=tuple(wide_cols),
+            wide_cols=wide_cols,
         )
 
         def flush(args):
@@ -949,10 +1019,16 @@ def replay_scan_pallas_packed(
     (rows, out), _ = jax.lax.scan(
         body, (rows0, out_rows0), (ev_blocks, seg_b, row_b, reset_b)
     )
-    return (
-        rows_to_state(rows[:, :L], rm),
-        rows_to_state(out, rm),
-    )
+    return rows, out
+
+
+@functools.lru_cache(maxsize=64)
+def _interp_packed_exec(caps, tb, bt, wide_cols, avkey):
+    avals = [jax.ShapeDtypeStruct(k[0], k[1]) for k in avkey]
+    low = _packed_scan_core.lower(
+        *avals, caps=caps, tb=tb, bt=bt, interpret=True,
+        wide_cols=wide_cols)
+    return low.compile({"xla_backend_optimization_level": 0})
 
 
 def replay_scan_pallas(
@@ -976,3 +1052,81 @@ def replay_scan_pallas(
         state, events_teb, caps, tb=tb, interpret=interpret, bt=bt,
         ablate=ablate,
     )
+
+
+# --------------------------------------------------------------------------
+# Blocked associative combine for the parallel-in-time replay
+# (ops/assoc.py). Composes per-step affine updates (mul, add) into
+# inclusive segmented prefixes: each grid step holds one tb-long time
+# block VMEM-resident, walks it sequentially on-chip, and carries the
+# running composition across blocks in scratch — the O(T) HBM traffic
+# of the composition stream is paid exactly once, block by block,
+# instead of lax.associative_scan's strided multi-level passes.
+# --------------------------------------------------------------------------
+
+
+def _affine_scan_kernel(mul_ref, add_ref, rst_ref, om_ref, oa_ref,
+                        mc, ac, *, tb: int):
+    """One time block: mul/add [TB, L, C], rst [TB, L]; scratch carries
+    the running (mul, add) composition [L, C] across grid steps."""
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        mc[...] = jnp.ones(mc.shape, jnp.int32)
+        ac[...] = jnp.zeros(ac.shape, jnp.int32)
+
+    def step(i, carry):
+        m = mul_ref[i]
+        a = add_ref[i]
+        rb = (rst_ref[i] != 0)[:, None]
+        # segment starts absorb the carry (the segmented combine)
+        pm = jnp.where(rb, m, mc[...] * m)
+        pa = jnp.where(rb, a, ac[...] * m + a)
+        mc[...] = pm
+        ac[...] = pa
+        om_ref[i] = pm
+        oa_ref[i] = pa
+        return carry
+
+    lax.fori_loop(0, tb, step, 0)
+
+
+def affine_segscan_pallas(mul, add, rst, tb: int = 8,
+                          interpret: bool | None = None):
+    """Segmented inclusive prefix composition of affine updates.
+
+    mul/add: [T, L, C] int32; rst: [T, L] (nonzero = step begins a new
+    segment). Returns (mul, add) prefixes — bit-identical to
+    ops.assoc.affine_segscan over the same stream
+    (tests/test_replay_pallas.py). ``T`` must be a multiple of ``tb``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T, L, C = mul.shape
+    if T % tb:
+        raise ValueError(f"T={T} not a multiple of tb={tb}")
+    grid = (T // tb,)
+    om, oa = pl.pallas_call(
+        functools.partial(_affine_scan_kernel, tb=tb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tb, L, C), lambda t: (t, 0, 0)),
+            pl.BlockSpec((tb, L, C), lambda t: (t, 0, 0)),
+            pl.BlockSpec((tb, L), lambda t: (t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tb, L, C), lambda t: (t, 0, 0)),
+            pl.BlockSpec((tb, L, C), lambda t: (t, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, L, C), jnp.int32),
+            jax.ShapeDtypeStruct((T, L, C), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((L, C), jnp.int32),
+            pltpu.VMEM((L, C), jnp.int32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(mul, jnp.int32), jnp.asarray(add, jnp.int32),
+      jnp.asarray(rst, jnp.int32))
+    return om, oa

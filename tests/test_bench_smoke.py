@@ -33,6 +33,27 @@ def test_smoke_emits_one_json_record():
     head = out["configs"]["retry_deep"]
     assert head["histories_per_sec"] > 0
     assert head["baseline_cpp_per_sec"] > 0
+    # backend selection is an explicit field of the record (the r05
+    # tail-note form was unparseable by trend tooling)
+    assert out["backend"]["platform"] == "cpu"
+    assert out["backend"]["probe"] == "smoke"
+    # the parallel-in-time contract: retry_deep must time the assoc
+    # kernel against the sequential scan (vs_scan is the trajectory
+    # BENCH_r06+ tracks) and record the us_per_step depth curve; the
+    # assoc-beats-scan assertion binds at real depth — smoke shapes are
+    # host-load noise, so at depth < 1k only the record shape is pinned
+    assoc = head["kernels"]["assoc"]
+    assert "vs_scan" in assoc and head["vs_scan"] == assoc["vs_scan"]
+    curve = assoc["depth_curve"]
+    assert len(curve) >= 2 and curve[-1]["depth"] >= curve[0]["depth"]
+    for pt in curve:
+        assert {"depth", "scan_us_per_step", "assoc_us_per_step",
+                "vs_scan"} <= set(pt)
+    if head["mean_depth"] >= 1000:
+        assert assoc["vs_scan"] > 1.0, (
+            "assoc kernel must beat the sequential scan on retry_deep "
+            f"at depth >= 1k (vs_scan={assoc['vs_scan']})"
+        )
     # the lane-packing contract: every config reports its padding waste,
     # and packed configs keep it < 1.0 (padded steps < real events) —
     # a packer regression (fragmenting lanes, over-rounding) fails here

@@ -209,3 +209,49 @@ def test_pallas_narrow_serving_path_interpret():
         jax.tree_util.tree_leaves(want),
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scan_mode_auto_matches_forced_scan():
+    """The dispatcher's default (assoc for unpacked XLA batches) must be
+    byte-identical to scan_mode="scan" — the same batches through both
+    kernels."""
+    hs = _histories(8, seed=9)
+    got_auto = replay_stream(hs, caps=CAPS, batch_size=8)
+    got_scan = replay_stream(hs, caps=CAPS, batch_size=8,
+                             scan_mode="scan")
+    for (pa, fa), (ps, fs) in zip(got_auto, got_scan):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(fa), jax.tree_util.tree_leaves(fs)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scan_mode_assoc_lane_packed_matches_scan():
+    """scan_mode="assoc" on the lane-packed pipeline: segment resets and
+    per-history output rows through the associative path."""
+    hs = _histories(10, seed=10)
+    got_a = replay_stream(hs, caps=CAPS, batch_size=10, lane_pack=True,
+                          scan_mode="assoc")
+    got_s = replay_stream(hs, caps=CAPS, batch_size=10, lane_pack=True,
+                          scan_mode="scan")
+    assert len(got_a) == len(got_s) == 1
+    (pa, fa), (ps, fs) = got_a[0], got_s[0]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(fa), jax.tree_util.tree_leaves(fs)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scan_mode_validated():
+    """Unknown scan_mode strings must raise up front — the kernel
+    selectors read the string in different places, so a typo would
+    otherwise silently pick a kernel."""
+    import pytest
+
+    from cadence_tpu.ops.replay import replay_packed
+
+    with pytest.raises(ValueError, match="scan_mode"):
+        DeviceDispatcher(caps=CAPS, scan_mode="asoc")
+    with pytest.raises(ValueError, match="scan_mode"):
+        replay_packed(pack_histories(_histories(2), caps=CAPS),
+                      scan_mode="Scan")

@@ -240,6 +240,89 @@ class TestTransitionSurface:
         assert "exec:X_NEXT_EVENT_ID" in doc["common"]
 
 
+
+# --------------------------------------------------------------------------
+# pass 1b — ASSOC-UNPROVEN (affine-decomposition coverage)
+# --------------------------------------------------------------------------
+
+
+class TestAssocCoverage:
+    def test_clean_tree(self, surface):
+        kmat, _, _, _ = surface
+        assert transition_surface.check_assoc_coverage(kmat) == []
+
+    def test_assoc_types_cover_every_kernel_block(self):
+        from cadence_tpu.ops.assoc import assoc_types
+
+        handled = transition_surface.kernel_handled_types()
+        assert handled <= assoc_types(), (
+            "kernel transition blocks outside the affine classifier"
+        )
+
+    def test_uncovered_write_fires(self, surface):
+        import dataclasses
+
+        from cadence_tpu.core.enums import EventType as E
+
+        kmat, _, _, _ = surface
+        groups = []
+        for g in kmat.groups:
+            w = set(g.written)
+            if int(E.TimerStarted) in g.types:
+                # pretend the kernel's TimerStarted block grew an exec
+                # write the emission never derived
+                w.add("exec:X_WORKFLOW_TIMEOUT")
+            groups.append(dataclasses.replace(g, written=w))
+        bad = transition_surface.KernelMatrix(
+            common=set(kmat.common), common_ts=set(kmat.common_ts),
+            groups=groups,
+        )
+        fs = transition_surface.check_assoc_coverage(bad)
+        assert any(
+            f.rule == "ASSOC-UNPROVEN" and f.anchor.endswith(":writes")
+            and "X_WORKFLOW_TIMEOUT" in f.message
+            for f in fs
+        ), fs
+
+    def test_unproven_group_fires(self, surface):
+        from cadence_tpu.core.enums import EventType as E
+
+        kmat, _, _, _ = surface
+        bad = transition_surface.KernelMatrix(
+            common=set(kmat.common), common_ts=set(kmat.common_ts),
+            groups=list(kmat.groups) + [transition_surface.GroupTrace(
+                types=(int(E.MarkerRecorded),),
+                written={"exec:X_STATE"}, ts_cols=set(),
+            )],
+        )
+        fs = transition_surface.check_assoc_coverage(bad)
+        assert any(
+            f.rule == "ASSOC-UNPROVEN" and f.anchor.endswith(":group")
+            for f in fs
+        ), fs
+
+    def test_stale_algebra_metadata_fires(self, surface, monkeypatch):
+        from cadence_tpu.ops import schema as S
+
+        kmat, _, _, _ = surface
+        monkeypatch.setitem(
+            S.UPDATE_ALGEBRA, "timers:TI_STATUS", "counter")
+        fs = transition_surface.check_assoc_coverage(kmat)
+        assert any(
+            f.rule == "ASSOC-UNPROVEN"
+            and f.anchor == "assoc:algebra:timers:TI_STATUS"
+            for f in fs
+        ), fs
+
+    def test_update_algebra_values_validated(self):
+        from cadence_tpu.ops import schema as S
+
+        ns = dict(vars(S))
+        ns["UPDATE_ALGEBRA"] = {"exec:X_STATE": "quantum"}
+        with pytest.raises(AssertionError, match="quantum"):
+            S.validate(ns)
+
+
 # --------------------------------------------------------------------------
 # pass 2 — jit hazards
 # --------------------------------------------------------------------------
@@ -354,6 +437,86 @@ class TestJitHazards:
 
     def test_real_step_stays_int32(self):
         assert jit_hazards.check_step_dtypes() == []
+
+
+
+    def test_pallas_int16_arith_fixture_fires(self):
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def kern(ev_ref, out_ref):
+                lo = ev_ref[0].astype(jnp.int16)
+                acc = lo * 3
+                out_ref[0] = acc + lo
+
+            def call(ev):
+                return pl.pallas_call(kern)(ev)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert any(f.rule == "PALLAS-INT16-ARITH" for f in fs), fs
+
+    def test_pallas_int16_widened_passes(self):
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def kern(ev_ref, out_ref):
+                lo = ev_ref[0].astype(jnp.int16).astype(jnp.int32)
+                out_ref[0] = lo * 3 + 1
+
+            def call(ev):
+                return pl.pallas_call(kern)(ev)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert not any(f.rule == "PALLAS-INT16-ARITH" for f in fs), fs
+
+    def test_pallas_int16_renarrowed_after_widen_fires(self):
+        # classification is line-ordered: a name widened early but
+        # re-assigned from an int16 cast later is narrow at the use —
+        # a whole-function widened-set would miss this
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def kern(a_ref, b_ref, out_ref):
+                x = a_ref[0].astype(jnp.int32)
+                y = x + 1
+                x = b_ref[0].astype(jnp.int16)
+                out_ref[0] = x * 3
+
+            def call(ev):
+                return pl.pallas_call(kern)(ev)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert any(f.rule == "PALLAS-INT16-ARITH" for f in fs), fs
+
+    def test_pallas_int16_rewiden_after_narrow_passes(self):
+        # the inverse order stays clean: narrow first, widened before
+        # every arithmetic use
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def kern(a_ref, out_ref):
+                x = a_ref[0].astype(jnp.int16)
+                x = x.astype(jnp.int32)
+                out_ref[0] = x * 3
+
+            def call(ev):
+                return pl.pallas_call(kern)(ev)
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert not any(f.rule == "PALLAS-INT16-ARITH" for f in fs), fs
+
+    def test_pallas_int16_outside_kernel_ignored(self):
+        # host-side narrowing (the packer) is the narrow stream's
+        # legitimate producer — only Pallas kernel bodies are in scope
+        src = textwrap.dedent("""
+            import jax.numpy as jnp
+
+            def host_pack(ev):
+                lo = ev.astype(jnp.int16)
+                return lo * 1
+        """)
+        fs = jit_hazards.lint_source(src, "fix.py")
+        assert not any(f.rule == "PALLAS-INT16-ARITH" for f in fs), fs
 
 
 # --------------------------------------------------------------------------
