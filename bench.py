@@ -436,6 +436,147 @@ def _bench_config_packed(config: str, caps, lanes: int, lane_len: int,
     }
 
 
+def _bench_reshard_live(duration_s: float, load_threads: int = 2,
+                        probe_interval_s: float = 0.004):
+    """Elastic resharding under sustained load: a shard split executed
+    mid-run while load threads drive the echo workflow end-to-end and a
+    probe thread times every frontend start call (the routed write path
+    — exactly what stalls while the source shard is fenced).
+
+    Reports the steady-state completion rate next to the handoff
+    record: total ``handoff_ms`` (dominated by the pre-fence checkpoint
+    flush, which runs under live traffic), the write-unavailability
+    ``pause_ms``, and the probe-call p50/p99 — overall and within the
+    handoff window, the decision-latency cost of the reconfiguration.
+    """
+    import threading as _threading
+
+    from cadence_tpu.runtime.api import StartWorkflowRequest
+    from cadence_tpu.runtime.resharding import ReshardCoordinator
+    from cadence_tpu.testing.onebox import Onebox
+    from cadence_tpu.worker import Worker
+
+    box = Onebox(num_shards=2, checkpoints=True,
+                 start_worker=False).start()
+    box.domain_handler.register_domain("bench")
+
+    def _echo_wf(ctx, input):
+        out = yield ctx.schedule_activity("echo", input)
+        return out
+
+    w = Worker(box.frontend, "bench", "bench-tl", identity="bench-w",
+               sticky=False)
+    w.register_workflow("echo-wf", _echo_wf)
+    w.register_activity("echo", lambda x: x)
+    w.start()
+
+    stop = _threading.Event()
+    completed = [0]
+    lock = _threading.Lock()
+
+    def _start(wid):
+        return box.frontend.start_workflow_execution(StartWorkflowRequest(
+            domain="bench", workflow_id=wid, workflow_type="echo-wf",
+            task_list="bench-tl", input=b"x", request_id=f"req-{wid}",
+            execution_start_to_close_timeout_seconds=60,
+        ))
+
+    def _load(tid):
+        i = 0
+        while not stop.is_set():
+            wid = f"load-{tid}-{i}"
+            try:
+                rid = _start(wid)
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline and not stop.is_set():
+                    d = box.frontend.describe_workflow_execution(
+                        "bench", wid, rid
+                    )
+                    if not d.is_running:
+                        with lock:
+                            completed[0] += 1
+                        break
+                    time.sleep(0.002)
+            except Exception:
+                pass  # fenced-window stragglers: the probe counts those
+            i += 1
+
+    probes = []  # (t_monotonic, latency_s)
+
+    def _probe():
+        j = 0
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                _start(f"probe-{j}")
+            except Exception:
+                pass
+            probes.append((t0, time.monotonic() - t0))
+            j += 1
+            time.sleep(probe_interval_s)
+
+    threads = [
+        _threading.Thread(target=_load, args=(t,), daemon=True)
+        for t in range(load_threads)
+    ] + [_threading.Thread(target=_probe, daemon=True)]
+    t_run0 = time.monotonic()
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(duration_s / 2)
+
+        coord = ReshardCoordinator(
+            box.persistence, [box.history.controller]
+        )
+        t_h0 = time.monotonic()
+        plan = coord.split(0)
+        t_h1 = time.monotonic()
+
+        time.sleep(duration_s / 2)
+    finally:
+        # a failed split must not leak live pumps into later configs
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        elapsed = time.monotonic() - t_run0
+        w.stop()
+        box.stop()
+
+    def _pct(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    lat_all = [dt for _, dt in probes]
+    lat_handoff = [
+        dt for t0, dt in probes if t_h0 <= t0 <= t_h1
+    ]
+    return {
+        "steady_rate_wf_per_sec": round(completed[0] / elapsed, 2),
+        "workflows_completed": completed[0],
+        "probe_calls": len(probes),
+        "start_p50_ms": round(_pct(lat_all, 0.50) * 1e3, 3),
+        "start_p99_ms": round(_pct(lat_all, 0.99) * 1e3, 3),
+        "during_handoff": {
+            "samples": len(lat_handoff),
+            "p50_ms": round(_pct(lat_handoff, 0.50) * 1e3, 3),
+            "p99_ms": round(_pct(lat_handoff, 0.99) * 1e3, 3),
+            "max_ms": round(max(lat_handoff, default=0.0) * 1e3, 3),
+        },
+        "handoff": {
+            "state": plan.state,
+            "epoch": plan.epoch_to,
+            "handoff_ms": round(plan.handoff_ms, 1),
+            "pause_ms": round(plan.pause_ms, 1),
+            "moved_workflows": plan.moved_workflows,
+            "moved_tasks": plan.moved_tasks,
+            "checkpoints_shipped": plan.checkpoints_shipped,
+            "suffix_events_replayed": plan.suffix_events_replayed,
+        },
+    }
+
+
 def _bench_rebuild_warm(n_hist: int, depth: int, iters: int,
                         tail_frac: float = 0.125):
     """Checkpointed incremental replay: rebuild the same cohort twice.
@@ -985,6 +1126,10 @@ def main() -> None:
         # bound (full rebuild_many pipeline), so the cohort stays modest
         "rebuild_warm": dict(
             warm=dict(n=96 if on_cpu else 256, depth=1000, iters=2)),
+        # elastic resharding under live traffic: shard split mid-run,
+        # decision-latency probes through the fenced window
+        # (runtime/resharding.py; README "Elastic resharding")
+        "reshard_live": dict(reshard=dict(duration_s=16.0)),
     }
 
     if SMOKE:
@@ -1003,6 +1148,9 @@ def main() -> None:
             # checkpoint-resume contract coverage (suffix_frac < 1.0,
             # checkpoint_hit_rate reported) at seconds-scale shapes
             "rebuild_warm": dict(warm=dict(n=24, depth=40, iters=1)),
+            # reshard JSON contract at seconds-scale load
+            "reshard_live": dict(
+                reshard=dict(duration_s=2.0, probe_interval_s=0.02)),
         }
 
     copy_bw = measure_copy_bw_gbps() if not on_cpu else None
@@ -1030,7 +1178,14 @@ def main() -> None:
         ):
             results[config] = {"skipped": "bench budget exhausted"}
             continue
-        if "warm" in cfg:
+        if "reshard" in cfg:
+            try:
+                results[config] = _bench_reshard_live(**cfg["reshard"])
+            except Exception as e:  # a wedged box must not eat the record
+                results[config] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        elif "warm" in cfg:
             results[config] = _bench_rebuild_warm(
                 cfg["warm"]["n"], cfg["warm"]["depth"],
                 cfg["warm"]["iters"])

@@ -15,11 +15,24 @@ from the AST:
   condition being held* (waiting on a held Condition releases it; an
   Event.wait under someone else's lock stalls every other holder).
 
-Known limits (documented, deliberate): cross-class propagation only
-happens through attribute-name heuristics (a call whose receiver chain
-mentions ``persistence``/store managers counts as I/O), and dynamic
-dispatch through callbacks is matched by callable-attribute *name*
-(e.g. ``self._update_shard_ack(...)``). Non-blocking try-locks
+Cross-class lock propagation: a call under a held lock whose receiver
+is NOT ``self`` (``handle.shard.fence()``, ``c.acquire_shards()``) is
+resolved by METHOD NAME against every class in scope. When the name
+resolves unambiguously — exactly one scope class defines it, or every
+defining class agrees it blocks — the callee's blocking work surfaces
+as LOCK-CROSS-BLOCKING at the caller, and the callee's lock
+acquisitions become cross-class edges in the inversion graph (a
+coordinator holding its own lock while fencing a shard context now
+participates in the same order proof as the context's lock). Names
+defined by many scope classes with disagreeing behavior are skipped —
+resolution is by name, not type inference, and a wrong guess would be
+noise, not safety.
+
+Known limits (documented, deliberate): remaining cross-class reasoning
+is attribute-name heuristics (a call whose receiver chain mentions
+``persistence``/store managers counts as I/O), and dynamic dispatch
+through callbacks is matched by callable-attribute *name* (e.g.
+``self._update_shard_ack(...)``). Non-blocking try-locks
 (``acquire(blocking=False)``) are exempt.
 """
 
@@ -53,6 +66,22 @@ STORE_METHODS = {
 STORE_RECEIVERS = ("persistence", "_conn", ".store", ".shard.")
 
 ALWAYS_BLOCKING_ATTRS = {"sleep", "join"}
+
+# lock-protocol attrs never treated as cross-class method calls
+_LOCK_OPS = {"acquire", "release", "wait", "notify", "notify_all",
+             "locked"}
+
+# names shared with builtin container/string/file protocols: a
+# same-named scope method is coincidence, not a resolution target
+# (``failures.append(...)`` is a list, not TaskWriter.append)
+_BUILTIN_METHOD_NAMES = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "get", "put", "add", "discard", "setdefault", "keys",
+    "values", "items", "sort", "reverse", "count", "index", "copy",
+    "split", "rsplit", "join", "strip", "lstrip", "rstrip", "replace",
+    "format", "encode", "decode", "startswith", "endswith", "lower",
+    "upper", "read", "write", "close", "flush", "seek",
+}
 
 # callable-attribute name fragments treated as blocking when invoked
 BLOCKING_CALLABLE_HINTS = ("update_shard",)
@@ -141,6 +170,11 @@ class MethodInfo:
     under_lock: List[Tuple[str, BlockingCall]]   # (held lock, call)
     edges: List[Tuple[str, str, int]]       # (held, acquired, lineno)
     self_calls_under_lock: List[Tuple[str, str, int]]  # (held, method, line)
+    # (held lock, method name, lineno, receiver) for non-self receivers
+    # — resolved cross-class by name in collect_findings
+    ext_calls_under_lock: List[Tuple[str, str, int, str]] = dataclasses.field(
+        default_factory=list
+    )
 
 
 class _MethodVisitor(ast.NodeVisitor):
@@ -220,6 +254,21 @@ class _MethodVisitor(ast.NodeVisitor):
             self.info.self_calls_under_lock.append(
                 (self.held[-1], node.func.attr, node.lineno)
             )
+        # any OTHER receiver's method under a held lock → cross-class
+        # propagation candidate (resolved by name in collect_findings);
+        # calls already classified blocking above are not re-recorded
+        elif (
+            self.held
+            and isinstance(node.func, ast.Attribute)
+            and reason is None
+            and node.func.attr not in _LOCK_OPS
+            and node.func.attr not in _BUILTIN_METHOD_NAMES
+        ):
+            recv = _dotted(node.func.value)
+            if recv != "self" and not recv.startswith("super()"):
+                self.info.ext_calls_under_lock.append(
+                    (self.held[-1], node.func.attr, node.lineno, recv)
+                )
         self.generic_visit(node)
 
 
@@ -307,6 +356,12 @@ def collect_findings(classes: List[ClassAnalysis]) -> List[Finding]:
     # edge map for inversion detection across the whole scope
     edges: Dict[Tuple[str, str], str] = {}
 
+    # cross-class resolution index: method name → defining scope classes
+    defs: Dict[str, List[Tuple[ClassAnalysis, MethodInfo]]] = {}
+    for cls in classes:
+        for mname, info in cls.methods.items():
+            defs.setdefault(mname, []).append((cls, info))
+
     for cls in classes:
         for mname, info in cls.methods.items():
             # direct blocking calls under a held lock
@@ -343,6 +398,47 @@ def collect_findings(classes: List[ClassAnalysis]) -> List[Finding]:
                             f"{cls.module}:{line} "
                             f"({cls.name}.{mname} → self.{callee})",
                         )
+            # cross-class propagation: a non-self receiver's method,
+            # resolved by name against the scope classes — blocking
+            # work in the callee fires at the caller, and the callee's
+            # lock acquisitions join the inversion graph. Ambiguous
+            # names (several scope classes, disagreeing behavior) are
+            # skipped: name resolution is not type inference.
+            for held, callee, line, recv in info.ext_calls_under_lock:
+                cands = defs.get(callee, [])
+                if not cands:
+                    continue
+                blocking = [
+                    c for c in cands
+                    if c[1].blocking or c[1].under_lock
+                ]
+                if len(cands) == 1 or len(blocking) == len(cands):
+                    if blocking:
+                        tcls, tinfo = blocking[0]
+                        why = (
+                            tinfo.blocking[0].why if tinfo.blocking
+                            else tinfo.under_lock[0][1].why
+                        )
+                        findings.append(Finding(
+                            "LOCK-CROSS-BLOCKING",
+                            f"{cls.module}:{cls.name}.{mname}:"
+                            f"{held.rsplit('.', 1)[-1]}:{callee}",
+                            f"{cls.module}:{line}: {cls.name}.{mname} "
+                            f"holds {held} while calling "
+                            f"{recv}.{callee}() → {tcls.name}.{callee}"
+                            f" which does blocking work ({why})",
+                        ))
+                if len(cands) == 1:
+                    tcls, tinfo = cands[0]
+                    for acq in tinfo.acquires:
+                        a = _lock_id(cls, held)
+                        b = _lock_id(tcls, acq)
+                        if a != b:
+                            edges.setdefault(
+                                (a, b),
+                                f"{cls.module}:{line} ({cls.name}."
+                                f"{mname} → {tcls.name}.{callee})",
+                            )
             # direct nesting edges
             for held, acquired, line in info.edges:
                 a, b = _lock_id(cls, held), _lock_id(cls, acquired)

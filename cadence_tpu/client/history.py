@@ -9,6 +9,7 @@ which retries after the ring settles — retryableClient.go).
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Dict
 
@@ -16,9 +17,26 @@ from cadence_tpu.runtime.controller import (
     ShardController,
     ShardOwnershipLostError,
 )
+from cadence_tpu.runtime.persistence.errors import (
+    ShardOwnershipLostError as PersistenceShardOwnershipLost,
+)
 
-_OWNERSHIP_RETRY = 3
+# Bounded ownership-lost retry (reference retryableClient.go): every
+# attempt re-resolves through the controllers, so a shard mid-move —
+# reshard handoff or plain membership churn — is found at its new
+# owner once the routing epoch flips. Jittered exponential backoff
+# decorrelates the thundering herd of callers all retrying the same
+# moved shard.
+_OWNERSHIP_RETRY = 6
 _OWNERSHIP_BACKOFF_S = 0.05
+_OWNERSHIP_BACKOFF_MAX_S = 1.0
+
+
+def _ownership_backoff_s(attempt: int, rng=random) -> float:
+    base = min(
+        _OWNERSHIP_BACKOFF_S * (2 ** (attempt - 1)), _OWNERSHIP_BACKOFF_MAX_S
+    )
+    return base * rng.uniform(0.5, 1.5)
 
 
 class HistoryClient:
@@ -41,19 +59,33 @@ class HistoryClient:
         self._controllers.pop(identity, None)
 
     def _engine_for(self, workflow_id: str):
+        """ONE ring/shard-map pass over the controllers (retry policy
+        lives in _call, wrapping the engine invocation too)."""
         last_err = None
-        for attempt in range(_OWNERSHIP_RETRY):
-            if attempt:
-                time.sleep(_OWNERSHIP_BACKOFF_S * attempt)
-            for controller in self._controllers.values():
-                try:
-                    return controller.get_engine(workflow_id)
-                except ShardOwnershipLostError as e:
-                    last_err = e
+        for controller in self._controllers.values():
+            try:
+                return controller.get_engine(workflow_id)
+            except ShardOwnershipLostError as e:
+                last_err = e
         raise last_err or ShardOwnershipLostError(-1, "<unknown>")
 
     def _call(self, workflow_id: str, method: str, *args, **kwargs):
-        return getattr(self._engine_for(workflow_id), method)(*args, **kwargs)
+        """Resolve + invoke under a bounded ownership-lost retry: BOTH
+        shapes — the controller's (no local handle) and the persistence
+        rangeID-fencing sibling raised mid-call by a fenced/stolen
+        shard — re-resolve and retry instead of surfacing to callers
+        (frontends saw the raw error during any ownership change)."""
+        last_err = None
+        for attempt in range(_OWNERSHIP_RETRY):
+            if attempt:
+                time.sleep(_ownership_backoff_s(attempt))
+            try:
+                engine = self._engine_for(workflow_id)
+                return getattr(engine, method)(*args, **kwargs)
+            except (ShardOwnershipLostError,
+                    PersistenceShardOwnershipLost) as e:
+                last_err = e
+        raise last_err
 
     # -- workflow mutations (routed by workflow_id) --------------------
 

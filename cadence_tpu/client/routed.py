@@ -135,7 +135,14 @@ class RoutedHistoryClient(HistoryClient):
         )
 
     def _call_once(self, workflow_id: str, method: str, *args, **kwargs):
-        shard_id = shard_for_workflow(workflow_id, self.num_shards)
+        # epoch-versioned routing: after a reshard flip the resolver's
+        # ShardMap is the truth; the static modulo is only the pre-
+        # reshard (epoch 0) fallback for monitors without a map
+        shard_map = self.monitor.resolver("history").shard_map()
+        if shard_map is not None:
+            shard_id = shard_map.shard_for(workflow_id)
+        else:
+            shard_id = shard_for_workflow(workflow_id, self.num_shards)
         owner = self.monitor.resolver("history").lookup(
             str(shard_id)
         ).identity

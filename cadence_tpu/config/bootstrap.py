@@ -232,6 +232,8 @@ def start_services(
             metrics=metrics,
             checkpoints=checkpoints,
         )
+        # admin reshard verbs read the section off the service
+        history.resharding_config = cfg.resharding
         out.history = history
 
     hc = RoutedHistoryClient(

@@ -182,6 +182,29 @@ class CheckpointConfig:
 
 
 @dataclasses.dataclass
+class ReshardingConfig:
+    """Elastic resharding (runtime/resharding.py).
+
+    ``drainTimeoutSeconds`` bounds the fence-drain step of a handoff —
+    a shard whose queues cannot quiesce in time rolls the whole
+    reconfiguration back. ``checkpointFlush`` ships ReplayCheckpoint
+    snapshots to the new owner (suffix-only replay); off, the new owner
+    cold-rebuilds from the execution store (still correct, just cold).
+    Enabled by default: the coordinator only runs on explicit admin
+    verbs, so an idle section costs nothing."""
+
+    enabled: bool = True
+    drain_timeout_s: float = 10.0
+    checkpoint_flush: bool = True
+
+    def validate(self) -> None:
+        if self.drain_timeout_s <= 0:
+            raise ConfigError(
+                "resharding.drainTimeoutSeconds must be > 0"
+            )
+
+
+@dataclasses.dataclass
 class ServerConfig:
     persistence: PersistenceConfig = dataclasses.field(
         default_factory=PersistenceConfig
@@ -195,6 +218,9 @@ class ServerConfig:
     checkpoint: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig
     )
+    resharding: ReshardingConfig = dataclasses.field(
+        default_factory=ReshardingConfig
+    )
     dynamicconfig_path: str = ""
     archival_dir: str = ""
 
@@ -203,6 +229,7 @@ class ServerConfig:
         self.cluster.validate()
         self.chaos.validate()
         self.checkpoint.validate()
+        self.resharding.validate()
         for name in self.services:
             if name not in SERVICES:
                 raise ConfigError(f"services: unknown service '{name}'")
@@ -307,6 +334,14 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "everyEvents": "every_events",
             "keepLast": "keep_last",
         }, "checkpoint"))
+
+    rsh = raw.pop("resharding", None)
+    if rsh:
+        cfg.resharding = ReshardingConfig(**_take(rsh, {
+            "enabled": "enabled",
+            "drainTimeoutSeconds": "drain_timeout_s",
+            "checkpointFlush": "checkpoint_flush",
+        }, "resharding"))
 
     dc = raw.pop("dynamicConfig", None)
     if dc:

@@ -12,7 +12,6 @@ from typing import Any, Dict, List
 
 from cadence_tpu.runtime.api import BadRequestError, EntityNotExistsServiceError
 from cadence_tpu.runtime.persistence.errors import EntityNotExistsError
-from cadence_tpu.utils.hashing import shard_for_workflow
 
 
 class AdminHandler:
@@ -22,6 +21,59 @@ class AdminHandler:
         # message bus for DLQ operator verbs (None on hosts that don't
         # run the messaging plane)
         self.bus = bus
+        self._resharder = None
+        import threading
+
+        self._resharder_lock = threading.Lock()
+
+    # -- elastic resharding (runtime/resharding.py) --------------------
+
+    @property
+    def resharder(self):
+        """Lazily-built reshard coordinator over this host's controller
+        (multi-host in-process clusters build their own coordinator
+        spanning every controller). Built under a lock: two racing
+        admin verbs must share ONE coordinator — its internal lock is
+        what serializes reconfigurations in-process."""
+        with self._resharder_lock:
+            if self._resharder is None:
+                from cadence_tpu.runtime.resharding import (
+                    ReshardCoordinator,
+                )
+
+                cfg = getattr(self.history, "resharding_config", None)
+                self._resharder = ReshardCoordinator(
+                    self.history.persistence,
+                    [self.history.controller],
+                    metrics=self.history.metrics,
+                    drain_timeout_s=(
+                        cfg.drain_timeout_s if cfg is not None else 10.0
+                    ),
+                    checkpoint_flush=(
+                        cfg.checkpoint_flush if cfg is not None else True
+                    ),
+                )
+            return self._resharder
+
+    def reshard_split(self, shard_id: int) -> Dict[str, Any]:
+        """Online shard split 1→2 (admin verb; returns the committed
+        plan record)."""
+        self._check_resharding_enabled()
+        return self.resharder.split(int(shard_id)).to_dict()
+
+    def reshard_merge(self, source_id: int, target_id: int) -> Dict[str, Any]:
+        """Online shard merge 2→1."""
+        self._check_resharding_enabled()
+        return self.resharder.merge(int(source_id), int(target_id)).to_dict()
+
+    def reshard_status(self) -> Dict[str, Any]:
+        """Current routing epoch + the last plan's write-ahead record."""
+        return self.resharder.status()
+
+    def _check_resharding_enabled(self) -> None:
+        cfg = getattr(self.history, "resharding_config", None)
+        if cfg is not None and not cfg.enabled:
+            raise BadRequestError("resharding is disabled by config")
 
     def describe_queue_states(self, shard_id: int) -> Dict[str, Any]:
         """Per-queue cursor/depth introspection for one owned shard
@@ -129,8 +181,9 @@ class AdminHandler:
     ) -> Dict[str, Any]:
         """Admin variant: shard id + raw mutable-state snapshot."""
         domain_id = self.domains.get_by_name(domain_name).info.id
-        num_shards = self.history.controller.num_shards
-        shard_id = shard_for_workflow(workflow_id, num_shards)
+        # epoch-versioned routing: the controller's ShardMap, not a
+        # static modulo (a resharded workflow lives on its NEW shard)
+        shard_id = self.history.controller.shard_for(workflow_id)
         engine = self.history.controller.get_engine_for_shard(shard_id)
         if not run_id:
             run_id = engine._current_run_id(domain_id, workflow_id)

@@ -115,12 +115,16 @@ class DeviceDispatcher:
         #             (ops/assoc.py) for both unpacked and lane-packed
         #             batches (lane-packed falls back per batch when a
         #             type is not provably affine).
-        #   "auto"  — assoc for unpacked XLA batches (scan depth is
-        #             their cost; retry_deep/ndc_storm are ~10x faster
-        #             on CPU), sequential for lane-packed ones (packing
-        #             already flattens depth to ~total/lanes, where the
-        #             assoc path's per-history provenance scatters lose)
-        #             and for the Pallas serving path on TPU.
+        #   "auto"  — assoc for both unpacked AND lane-packed XLA
+        #             batches when every present type is provably
+        #             affine (unpacked: scan depth is the cost, ~10x on
+        #             retry_deep/ndc_storm; lane-packed: the former
+        #             provenance-scatter regression on shallow batches
+        #             is gone — batch-major planes + the flat
+        #             scatter-max provenance measure 0.3-1.0x the
+        #             sequential packed scan across shallow shapes,
+        #             winning past ~128 histories), sequential for the
+        #             Pallas serving path on TPU.
         if scan_mode not in ("auto", "scan", "assoc"):
             raise ValueError(
                 "scan_mode must be 'auto', 'scan', or 'assoc' "
@@ -262,7 +266,12 @@ class DeviceDispatcher:
         return not non
 
     def _assoc_lanes(self, use_pallas: bool, present) -> bool:
-        if self.scan_mode != "assoc" or not self._assoc_enabled(use_pallas):
+        """Lane-packed twin of _assoc_hist: ``auto`` routes affine
+        batches to the associative kernel too (mirroring the serving
+        facade replay_packed_lanes — the dispatcher used to hold lanes
+        back on the since-fixed shallow-batch provenance-scatter
+        regression; see the scan_mode comment above)."""
+        if not self._assoc_enabled(use_pallas):
             return False
         from .replay import assoc_classify_types
 

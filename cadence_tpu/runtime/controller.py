@@ -4,6 +4,14 @@ Reference: /root/reference/service/history/shardController.go:96,148-389 —
 one engine per owned shard; a management pump re-evaluates ownership on
 every membership ChangedEvent, acquiring newly-owned shards and
 releasing stolen ones (the new owner's lease bump fences the old one).
+
+Elastic resharding (runtime/resharding.py): routing is an
+epoch-versioned ShardMap held by the history ServiceResolver, so the
+set of shard ids is no longer frozen at construction — a committed
+split/merge flips the map, the resolver listeners re-fire, and
+``acquire_shards`` walks the NEW id set. During the brief dual-read
+window after a flip, ``get_engine`` falls back to the previous epoch's
+shard handle so reads racing the flip don't error needlessly.
 """
 
 from __future__ import annotations
@@ -12,7 +20,6 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from cadence_tpu.utils.clock import TimeSource
-from cadence_tpu.utils.hashing import shard_for_workflow
 from cadence_tpu.utils.log import get_logger
 
 from .domains import DomainCache
@@ -53,7 +60,7 @@ class ShardController:
         engine_factory: Optional[Callable[[ShardContext], _ShardHandle]] = None,
         time_source: Optional[TimeSource] = None,
     ) -> None:
-        self.num_shards = num_shards
+        self.initial_num_shards = num_shards
         self.persistence = persistence
         self.domains = domain_cache
         self.monitor = monitor
@@ -64,23 +71,63 @@ class ShardController:
         self._handles: Dict[int, _ShardHandle] = {}
         self._log = get_logger("cadence_tpu.shardController", host=self.identity)
         self._resolver: ServiceResolver = monitor.resolver("history")
+        self._install_shard_map(num_shards)
         self._resolver.add_listener(
             f"shardController-{self.identity}", lambda ev: self.acquire_shards()
         )
 
+    def _install_shard_map(self, num_shards: int) -> None:
+        """Adopt the durable routing map: a committed reshard outlives
+        every host restart, so the store's epoch wins over both the
+        constructor arg and any stale resolver state."""
+        from .resharding import ShardMap, load_reshard_state
+
+        stored, _ = load_reshard_state(self.persistence.shard)
+        current = self._resolver.shard_map()
+        if stored is not None and (
+            current is None or stored.epoch > current.epoch
+        ):
+            self._resolver.set_shard_map(stored)
+        elif current is None:
+            self._resolver.set_shard_map(ShardMap.initial(num_shards))
+
     # -- ownership -----------------------------------------------------
+
+    @property
+    def shard_map(self):
+        return self._resolver.shard_map()
+
+    @property
+    def num_shards(self) -> int:
+        """Live shard count under the current routing epoch."""
+        m = self._resolver.shard_map()
+        return m.num_shards if m is not None else self.initial_num_shards
+
+    def shard_ids(self) -> List[int]:
+        m = self._resolver.shard_map()
+        return (
+            m.shard_ids() if m is not None
+            else list(range(self.initial_num_shards))
+        )
 
     def _owned(self, shard_id: int) -> bool:
         return self._resolver.lookup(str(shard_id)).identity == self.identity
 
     def shard_for(self, workflow_id: str) -> int:
-        return shard_for_workflow(workflow_id, self.num_shards)
+        return self.shard_map.shard_for(workflow_id)
 
     def acquire_shards(self) -> None:
-        """Re-evaluate ownership for every shard (acquireShards :279-346)."""
-        for shard_id in range(self.num_shards):
+        """Re-evaluate ownership for every shard (acquireShards :279-346).
+        Walks the union of the current map's ids and anything still
+        held, so a merged-away shard's engine is released too."""
+        with self._lock:
+            held = set(self._handles)
+        # one consistent view of the id set for the whole sweep (a map
+        # flip mid-loop re-fires the listener and re-evaluates anyway)
+        ids = set(self.shard_ids())
+        for shard_id in sorted(ids | held):
             try:
-                owned = self._owned(shard_id)
+                owned = shard_id in ids and self._owned(shard_id)
             except RuntimeError:
                 owned = False  # empty ring
             with self._lock:
@@ -110,7 +157,23 @@ class ShardController:
     # -- engine lookup -------------------------------------------------
 
     def get_engine(self, workflow_id: str) -> HistoryEngine:
-        return self.get_engine_for_shard(self.shard_for(workflow_id))
+        current, previous = self._resolver.shard_maps()
+        shard_id = (
+            current.shard_for(workflow_id) if current is not None else 0
+        )
+        try:
+            return self.get_engine_for_shard(shard_id)
+        except ShardOwnershipLostError:
+            # dual-read window: a read racing a reshard flip may still
+            # find the outgoing epoch's handle on this host
+            if previous is not None:
+                prev_id = previous.shard_for(workflow_id)
+                if prev_id != shard_id:
+                    with self._lock:
+                        handle = self._handles.get(prev_id)
+                    if handle is not None:
+                        return handle.engine
+            raise
 
     def get_engine_for_shard(self, shard_id: int) -> HistoryEngine:
         with self._lock:
@@ -129,12 +192,14 @@ class ShardController:
 
     def describe(self) -> dict:
         """DescribeHistoryHost (service/history/handler.go:662)."""
+        m = self.shard_map
         with self._lock:
             return {
                 "identity": self.identity,
                 "shard_count": len(self._handles),
                 "shard_ids": sorted(self._handles),
                 "num_shards_total": self.num_shards,
+                "reshard_epoch": m.epoch if m is not None else 0,
             }
 
     def stop(self) -> None:

@@ -64,6 +64,40 @@ class ServiceResolver:
         self._ring: List[int] = []  # sorted vnode hashes
         self._ring_hosts: Dict[int, str] = {}
         self._listeners: Dict[str, Callable[[ChangedEvent], None]] = {}
+        # epoch-versioned shard routing (runtime/resharding.ShardMap):
+        # the reshard coordinator flips the current map atomically and
+        # keeps the outgoing one for a brief dual-read window so reads
+        # racing the flip can still find the old owner's handle
+        self._shard_map = None
+        self._prev_shard_map = None
+
+    # -- shard map (elastic resharding) --------------------------------
+
+    def set_shard_map(self, shard_map, previous=None) -> None:
+        """Atomically flip the routing epoch. ``previous`` keeps the
+        outgoing map readable (dual-read window) until
+        ``retire_previous_shard_map``."""
+        with self._lock:
+            if (
+                self._shard_map is not None
+                and shard_map.epoch < self._shard_map.epoch
+            ):
+                return  # a newer epoch already landed; never regress
+            self._prev_shard_map = previous
+            self._shard_map = shard_map
+
+    def shard_map(self):
+        with self._lock:
+            return self._shard_map
+
+    def shard_maps(self):
+        """(current, previous-or-None) under one lock acquisition."""
+        with self._lock:
+            return self._shard_map, self._prev_shard_map
+
+    def retire_previous_shard_map(self) -> None:
+        with self._lock:
+            self._prev_shard_map = None
 
     def _rebuild(self) -> None:
         self._ring = []

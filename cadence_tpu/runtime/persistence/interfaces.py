@@ -48,6 +48,23 @@ class ShardManager:
         """Conditioned on the stored range_id == previous_range_id."""
         raise NotImplementedError
 
+    # -- elastic resharding (runtime/resharding.py) -------------------
+
+    def get_reshard_state(self) -> Optional[Tuple[int, str]]:
+        """The singleton routing-epoch row: ``(epoch, blob)`` where the
+        blob carries the committed ShardMap + the in-flight/last
+        ReshardPlan (the reconfiguration write-ahead record), or None
+        when no reshard was ever attempted."""
+        raise NotImplementedError
+
+    def set_reshard_state(
+        self, epoch: int, blob: str, previous_epoch: int
+    ) -> None:
+        """LWT on the stored epoch (an absent row reads as epoch 0):
+        raises ConditionFailedError when ``previous_epoch`` doesn't
+        match — two coordinators can never both commit an epoch."""
+        raise NotImplementedError
+
 
 class ExecutionManager:
     """Per-shard workflow-execution store + transfer/timer/replication
@@ -114,6 +131,57 @@ class ExecutionManager:
         self, shard_id: int
     ) -> List[Tuple[str, str, str]]:
         """(domain_id, workflow_id, run_id) triples — scavenger support."""
+        raise NotImplementedError
+
+    # -- elastic resharding (runtime/resharding.py) -------------------
+
+    def reshard_extract(
+        self,
+        shard_id: int,
+        workflow_ids: List[str],
+        transfer_watermark: int,
+        timer_watermark: Tuple[int, int],
+        delete: bool = False,
+    ) -> Dict[str, list]:
+        """Collect everything of ``workflow_ids`` that must move with a
+        shard handoff: execution rows, current-execution rows, and the
+        pending queue tasks past the drained ack watermarks (tasks
+        at/below a watermark are durably complete and stay behind).
+        Replication tasks for the moved workflows move wholesale (their
+        per-cluster read cursors are shard-local, so moved tasks are
+        re-minted above the target's cursor).
+
+        ``delete=False`` is a pure read — the coordinator's
+        copy-then-purge move keeps the source rows intact until the
+        target copy durably landed (crash-safe in every window);
+        ``delete=True`` removes atomically (rollback cleanup).
+
+        Returns ``{"executions", "currents", "transfer", "timers",
+        "replication"}`` — the exact payload ``reshard_install``
+        accepts, on this or any other backend of the same schema."""
+        raise NotImplementedError
+
+    def reshard_install(
+        self,
+        shard_id: int,
+        range_id: int,
+        extracted: Dict[str, list],
+        task_id_fn,
+    ) -> None:
+        """Atomically install an extracted payload under ``shard_id``,
+        re-minting every queue task id from ``task_id_fn`` (the target
+        shard's block sequencer — moved tasks can never regress or
+        collide with the target's ids). Conditioned on the target's
+        stored range_id == ``range_id`` (all-or-nothing: a fenced or
+        partially-failed install leaves the target untouched)."""
+        raise NotImplementedError
+
+    def reshard_purge(
+        self, shard_id: int, extracted: Dict[str, list]
+    ) -> None:
+        """Delete exactly the rows named in an extracted payload from
+        ``shard_id`` (by ORIGINAL task ids) — the final step of a
+        copy-then-purge move. Idempotent."""
         raise NotImplementedError
 
     # -- transfer queue -----------------------------------------------

@@ -49,6 +49,8 @@ _ZOMBIE = 3  # WorkflowState.Zombie
 class MemoryShardManager(I.ShardManager):
     def __init__(self) -> None:
         self._shards: Dict[int, ShardInfo] = {}
+        # singleton routing-epoch row: (epoch, blob) or None
+        self._reshard_state: Optional[Tuple[int, str]] = None
         self._lock = threading.RLock()
 
     def create_shard(self, info: ShardInfo) -> None:
@@ -72,6 +74,23 @@ class MemoryShardManager(I.ShardManager):
             if stored.range_id != previous_range_id:
                 raise ShardOwnershipLostError(info.shard_id)
             self._shards[info.shard_id] = copy.deepcopy(info)
+
+    # -- elastic resharding -------------------------------------------
+
+    def get_reshard_state(self) -> Optional[Tuple[int, str]]:
+        with self._lock:
+            return self._reshard_state
+
+    def set_reshard_state(
+        self, epoch: int, blob: str, previous_epoch: int
+    ) -> None:
+        with self._lock:
+            stored = self._reshard_state[0] if self._reshard_state else 0
+            if stored != previous_epoch:
+                raise ConditionFailedError(
+                    f"reshard epoch {stored} != expected {previous_epoch}"
+                )
+            self._reshard_state = (epoch, blob)
 
 
 class MemoryExecutionManager(I.ExecutionManager):
@@ -314,6 +333,126 @@ class MemoryExecutionManager(I.ExecutionManager):
                 for (s, d, w, r) in self._executions
                 if s == shard_id
             ]
+
+    # -- elastic resharding -------------------------------------------
+
+    def reshard_extract(
+        self, shard_id, workflow_ids, transfer_watermark, timer_watermark,
+        delete=False,
+    ):
+        wids = set(workflow_ids)
+        out = {"executions": [], "currents": [], "transfer": [],
+               "timers": [], "replication": []}
+        with self._lock:
+            for key in [k for k in self._executions
+                        if k[0] == shard_id and k[2] in wids]:
+                snap, next_eid, lwv = (
+                    self._executions.pop(key) if delete
+                    else self._executions[key]
+                )
+                out["executions"].append({
+                    "domain_id": key[1], "workflow_id": key[2],
+                    "run_id": key[3], "next_event_id": next_eid,
+                    "last_write_version": lwv,
+                    "snapshot": copy.deepcopy(snap),
+                })
+            for key in [k for k in self._current
+                        if k[0] == shard_id and k[2] in wids]:
+                cur = (
+                    self._current.pop(key) if delete else self._current[key]
+                )
+                out["currents"].append({
+                    "domain_id": key[1], "workflow_id": key[2],
+                    "run_id": cur.run_id,
+                    "create_request_id": cur.create_request_id,
+                    "state": cur.state, "close_status": cur.close_status,
+                    "last_write_version": cur.last_write_version,
+                })
+            tq = self._transfer.get(shard_id, {})
+            for tid in [tid for tid, t in tq.items()
+                        if t.workflow_id in wids
+                        and tid > transfer_watermark]:
+                out["transfer"].append(
+                    tq.pop(tid) if delete else copy.deepcopy(tq[tid])
+                )
+            mq = self._timers.get(shard_id, {})
+            for key in [k for k, t in mq.items()
+                        if t.workflow_id in wids
+                        and k > tuple(timer_watermark)]:
+                out["timers"].append(
+                    mq.pop(key) if delete else copy.deepcopy(mq[key])
+                )
+            rq = self._replication.get(shard_id, {})
+            for tid in [tid for tid, t in rq.items()
+                        if t.workflow_id in wids]:
+                out["replication"].append(
+                    rq.pop(tid) if delete else copy.deepcopy(rq[tid])
+                )
+        for name in out:
+            key_fn = {
+                "executions": lambda e: (e["workflow_id"], e["run_id"]),
+                "currents": lambda e: e["workflow_id"],
+                "timers": lambda t: (t.visibility_timestamp, t.task_id),
+            }.get(name, lambda t: t.task_id)
+            out[name].sort(key=key_fn)
+        return out
+
+    def reshard_purge(self, shard_id, extracted):
+        with self._lock:
+            for e in extracted["executions"]:
+                self._executions.pop(
+                    (shard_id, e["domain_id"], e["workflow_id"],
+                     e["run_id"]), None,
+                )
+            for c in extracted["currents"]:
+                self._current.pop(
+                    (shard_id, c["domain_id"], c["workflow_id"]), None
+                )
+            tq = self._transfer.get(shard_id, {})
+            for t in extracted["transfer"]:
+                tq.pop(t.task_id, None)
+            mq = self._timers.get(shard_id, {})
+            for t in extracted["timers"]:
+                mq.pop((t.visibility_timestamp, t.task_id), None)
+            rq = self._replication.get(shard_id, {})
+            for t in extracted["replication"]:
+                rq.pop(t.task_id, None)
+
+    def reshard_install(self, shard_id, range_id, extracted, task_id_fn):
+        with self._lock:
+            stored = self._shard_manager.get_shard(shard_id)
+            if stored.range_id != range_id:
+                raise ShardOwnershipLostError(shard_id)
+            for e in extracted["executions"]:
+                key = (shard_id, e["domain_id"], e["workflow_id"],
+                       e["run_id"])
+                self._executions[key] = (
+                    copy.deepcopy(e["snapshot"]),
+                    e["next_event_id"], e["last_write_version"],
+                )
+            for c in extracted["currents"]:
+                self._current[(shard_id, c["domain_id"], c["workflow_id"])] \
+                    = CurrentExecution(
+                        run_id=c["run_id"],
+                        create_request_id=c["create_request_id"],
+                        state=c["state"], close_status=c["close_status"],
+                        last_write_version=c["last_write_version"],
+                    )
+            tq = self._transfer.setdefault(shard_id, {})
+            for t in extracted["transfer"]:
+                t = copy.deepcopy(t)
+                t.task_id = task_id_fn()
+                tq[t.task_id] = t
+            mq = self._timers.setdefault(shard_id, {})
+            for t in extracted["timers"]:
+                t = copy.deepcopy(t)
+                t.task_id = task_id_fn()
+                mq[(t.visibility_timestamp, t.task_id)] = t
+            rq = self._replication.setdefault(shard_id, {})
+            for t in extracted["replication"]:
+                t = copy.deepcopy(t)
+                t.task_id = task_id_fn()
+                rq[t.task_id] = t
 
     # -- transfer queue -----------------------------------------------
 

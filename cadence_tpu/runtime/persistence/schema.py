@@ -79,12 +79,19 @@ CREATE INDEX IF NOT EXISTS idx_ckpt_tree
   ON replay_checkpoints (tree_id, event_id);
 """
 
+_V4_RESHARD_STATE = """
+CREATE TABLE IF NOT EXISTS reshard_state (
+  id INTEGER PRIMARY KEY CHECK (id = 0),
+  epoch INTEGER NOT NULL, blob TEXT NOT NULL);
+"""
+
 # (version, name, script) — append-only, like the reference's
 # schema/cassandra/cadence/versioned/ dirs
 MIGRATIONS: List[Tuple[int, str, str]] = [
     (1, "base", _V1_BASE),
     (2, "query indexes", _V2_QUERY_INDEXES),
     (3, "replay checkpoints", _V3_REPLAY_CHECKPOINTS),
+    (4, "reshard state", _V4_RESHARD_STATE),
 ]
 
 CURRENT_SCHEMA_VERSION = MIGRATIONS[-1][0]

@@ -144,6 +144,30 @@ class SqliteShardManager(I.ShardManager):
                     raise EntityNotExistsError(f"shard {info.shard_id}")
                 raise ShardOwnershipLostError(info.shard_id)
 
+    # -- elastic resharding -------------------------------------------
+
+    def get_reshard_state(self):
+        with self.db.txn() as c:
+            row = c.execute(
+                "SELECT epoch, blob FROM reshard_state WHERE id=0"
+            ).fetchone()
+        return (int(row[0]), row[1]) if row else None
+
+    def set_reshard_state(self, epoch, blob, previous_epoch):
+        with self.db.txn() as c:
+            row = c.execute(
+                "SELECT epoch FROM reshard_state WHERE id=0"
+            ).fetchone()
+            stored = int(row[0]) if row else 0
+            if stored != previous_epoch:
+                raise ConditionFailedError(
+                    f"reshard epoch {stored} != expected {previous_epoch}"
+                )
+            c.execute(
+                "INSERT OR REPLACE INTO reshard_state VALUES (0,?,?)",
+                (epoch, blob),
+            )
+
 
 class SqliteExecutionManager(I.ExecutionManager):
     def __init__(self, db: _Db) -> None:
@@ -410,6 +434,165 @@ class SqliteExecutionManager(I.ExecutionManager):
                 (shard_id,),
             ).fetchall()
         return [tuple(r) for r in rows]
+
+    # -- elastic resharding -------------------------------------------
+
+    def reshard_extract(
+        self, shard_id, workflow_ids, transfer_watermark, timer_watermark,
+        delete=False,
+    ):
+        out = {"executions": [], "currents": [], "transfer": [],
+               "timers": [], "replication": []}
+        wids = sorted(set(workflow_ids))
+        if not wids:
+            return out
+        marks = ",".join("?" * len(wids))
+        with self.db.txn() as c:
+            for row in c.execute(
+                "SELECT domain_id, workflow_id, run_id, next_event_id, "
+                f"last_write_version, snapshot FROM executions "
+                f"WHERE shard_id=? AND workflow_id IN ({marks}) "
+                "ORDER BY workflow_id, run_id",
+                [shard_id] + wids,
+            ).fetchall():
+                out["executions"].append({
+                    "domain_id": row[0], "workflow_id": row[1],
+                    "run_id": row[2], "next_event_id": row[3],
+                    "last_write_version": row[4],
+                    "snapshot": serde.snapshot_from_json(row[5]),
+                })
+            for row in c.execute(
+                "SELECT domain_id, workflow_id, run_id, create_request_id,"
+                f" state, close_status, last_write_version "
+                f"FROM current_executions "
+                f"WHERE shard_id=? AND workflow_id IN ({marks}) "
+                "ORDER BY workflow_id",
+                [shard_id] + wids,
+            ).fetchall():
+                out["currents"].append({
+                    "domain_id": row[0], "workflow_id": row[1],
+                    "run_id": row[2], "create_request_id": row[3],
+                    "state": row[4], "close_status": row[5],
+                    "last_write_version": row[6],
+                })
+            tasks = [
+                serde.transfer_from_json(r[0]) for r in c.execute(
+                    "SELECT blob FROM transfer_tasks WHERE shard_id=? "
+                    "AND task_id>? ORDER BY task_id",
+                    (shard_id, transfer_watermark),
+                ).fetchall()
+            ]
+            out["transfer"] = [t for t in tasks if t.workflow_id in wids]
+            tasks = [
+                serde.timer_from_json(r[0]) for r in c.execute(
+                    "SELECT blob FROM timer_tasks WHERE shard_id=? "
+                    "AND (visibility_ts>? OR (visibility_ts=? AND "
+                    "task_id>?)) ORDER BY visibility_ts, task_id",
+                    (shard_id, timer_watermark[0], timer_watermark[0],
+                     timer_watermark[1]),
+                ).fetchall()
+            ]
+            out["timers"] = [t for t in tasks if t.workflow_id in wids]
+            tasks = [
+                serde.replication_from_json(r[0]) for r in c.execute(
+                    "SELECT blob FROM replication_tasks WHERE shard_id=? "
+                    "ORDER BY task_id", (shard_id,),
+                ).fetchall()
+            ]
+            out["replication"] = [
+                t for t in tasks if t.workflow_id in wids
+            ]
+            if delete:
+                self._purge_locked(c, shard_id, out)
+        return out
+
+    @staticmethod
+    def _purge_locked(c, shard_id, extracted) -> None:
+        for e in extracted["executions"]:
+            c.execute(
+                "DELETE FROM executions WHERE shard_id=? AND domain_id=? "
+                "AND workflow_id=? AND run_id=?",
+                (shard_id, e["domain_id"], e["workflow_id"], e["run_id"]),
+            )
+        for cur in extracted["currents"]:
+            c.execute(
+                "DELETE FROM current_executions WHERE shard_id=? AND "
+                "domain_id=? AND workflow_id=?",
+                (shard_id, cur["domain_id"], cur["workflow_id"]),
+            )
+        for t in extracted["transfer"]:
+            c.execute(
+                "DELETE FROM transfer_tasks WHERE shard_id=? AND "
+                "task_id=?", (shard_id, t.task_id),
+            )
+        for t in extracted["timers"]:
+            c.execute(
+                "DELETE FROM timer_tasks WHERE shard_id=? AND "
+                "visibility_ts=? AND task_id=?",
+                (shard_id, t.visibility_timestamp, t.task_id),
+            )
+        for t in extracted["replication"]:
+            c.execute(
+                "DELETE FROM replication_tasks WHERE shard_id=? AND "
+                "task_id=?", (shard_id, t.task_id),
+            )
+
+    def reshard_purge(self, shard_id, extracted):
+        with self.db.txn() as c:
+            self._purge_locked(c, shard_id, extracted)
+
+    def reshard_install(self, shard_id, range_id, extracted, task_id_fn):
+        import copy as _copy
+
+        with self.db.txn() as c:
+            row = c.execute(
+                "SELECT range_id FROM shards WHERE shard_id=?", (shard_id,)
+            ).fetchone()
+            if row is None:
+                raise EntityNotExistsError(f"shard {shard_id}")
+            if row[0] != range_id:
+                raise ShardOwnershipLostError(shard_id)
+            for e in extracted["executions"]:
+                c.execute(
+                    "INSERT OR REPLACE INTO executions VALUES "
+                    "(?,?,?,?,?,?,?)",
+                    (shard_id, e["domain_id"], e["workflow_id"],
+                     e["run_id"], e["next_event_id"],
+                     e["last_write_version"],
+                     serde.snapshot_to_json(e["snapshot"])),
+                )
+            for cur in extracted["currents"]:
+                c.execute(
+                    "INSERT OR REPLACE INTO current_executions VALUES "
+                    "(?,?,?,?,?,?,?,?)",
+                    (shard_id, cur["domain_id"], cur["workflow_id"],
+                     cur["run_id"], cur["create_request_id"],
+                     cur["state"], cur["close_status"],
+                     cur["last_write_version"]),
+                )
+            for t in extracted["transfer"]:
+                t = _copy.deepcopy(t)
+                t.task_id = task_id_fn()
+                c.execute(
+                    "INSERT OR REPLACE INTO transfer_tasks VALUES (?,?,?)",
+                    (shard_id, t.task_id, serde.transfer_to_json(t)),
+                )
+            for t in extracted["timers"]:
+                t = _copy.deepcopy(t)
+                t.task_id = task_id_fn()
+                c.execute(
+                    "INSERT OR REPLACE INTO timer_tasks VALUES (?,?,?,?)",
+                    (shard_id, t.visibility_timestamp, t.task_id,
+                     serde.timer_to_json(t)),
+                )
+            for t in extracted["replication"]:
+                t = _copy.deepcopy(t)
+                t.task_id = task_id_fn()
+                c.execute(
+                    "INSERT OR REPLACE INTO replication_tasks VALUES "
+                    "(?,?,?)",
+                    (shard_id, t.task_id, serde.replication_to_json(t)),
+                )
 
     # -- queues -------------------------------------------------------
 

@@ -220,6 +220,10 @@ class QueueProcessorBase:
         )
         self._notify = threading.Event()
         self._stopped = threading.Event()
+        # reshard fence: intake paused (no new batch reads) while
+        # in-flight tasks run to completion — the drain-to-watermark
+        # step of an ownership handoff
+        self._paused = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=worker_count, thread_name_prefix=f"{name}-worker"
         )
@@ -238,16 +242,48 @@ class QueueProcessorBase:
         self._notify.set()
         self._pool.shutdown(wait=False)
 
-    def drain(self, timeout_s: float = 5.0) -> bool:
-        """Wait until no tasks are outstanding (for tests/shutdown)."""
+    def drain(self, timeout_s: float = 5.0, *,
+              deadline: Optional[float] = None) -> bool:
+        """Wait until no tasks are outstanding (for tests/shutdown).
+        ``deadline`` (time.monotonic value) overrides ``timeout_s`` —
+        the reshard coordinator passes one shared deadline across every
+        pump it drains."""
         import time
 
-        deadline = time.monotonic() + timeout_s
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            if self.ack.outstanding() == 0 and not self._notify.is_set():
+            if self.ack.outstanding() == 0 and (
+                self._paused.is_set() or not self._notify.is_set()
+            ):
                 return True
             time.sleep(0.01)
         return False
+
+    # -- reshard fence -------------------------------------------------
+
+    def pause_intake(self) -> None:
+        """Stop reading new batches; in-flight tasks run to completion."""
+        self._paused.set()
+
+    def resume_intake(self) -> None:
+        self._paused.clear()
+        self._notify.set()
+
+    def fence_drain(self, deadline: float):
+        """Reshard handoff step (2): pause intake, drain in-flight work,
+        and return the recorded ack watermark — everything at/below it
+        is durably complete; everything above it moves with the shard.
+        Raises TimeoutError when the pump cannot quiesce by ``deadline``
+        (the coordinator rolls the handoff back)."""
+        self.pause_intake()
+        if not self.drain(deadline=deadline):
+            raise TimeoutError(
+                f"queue {self.name} failed to drain for reshard handoff "
+                f"({self.ack.outstanding()} in flight)"
+            )
+        sweep_ack(self.ack, self._log, self.name)
+        return self.ack.ack_level
 
     # -- pump ----------------------------------------------------------
 
@@ -270,6 +306,8 @@ class QueueProcessorBase:
 
     def _process_batch(self) -> None:
         while not self._stopped.is_set():
+            if self._paused.is_set():
+                return
             batch = self._read_batch(self.ack.read_level, self._batch_size)
             if not batch:
                 return

@@ -445,6 +445,7 @@ class TimerQueueStandbyProcessor:
             engine.domains, cluster, local_cluster=local_cluster
         )
         self._stopped = threading.Event()
+        self._paused = threading.Event()  # reshard fence: intake off
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(
@@ -480,15 +481,37 @@ class TimerQueueStandbyProcessor:
         # (and notified) through the remote-time listener list forever
         self.shard.remove_remote_time_listener(self._on_remote_time)
 
-    def drain(self, timeout_s: float = 5.0) -> bool:
+    def drain(self, timeout_s: float = 5.0, *, deadline=None) -> bool:
         import time
 
-        deadline = time.monotonic() + timeout_s
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if self.ack.outstanding() == 0:
                 return True
             time.sleep(0.01)
         return False
+
+    # -- reshard fence -------------------------------------------------
+
+    def pause_intake(self) -> None:
+        self._paused.set()
+
+    def resume_intake(self) -> None:
+        self._paused.clear()
+        self.gate.update(0)
+
+    def fence_drain(self, deadline: float):
+        """Pause intake, drain in-flight verifications, return the
+        standby (ts, id) ack watermark."""
+        self.pause_intake()
+        if not self.drain(deadline=deadline):
+            raise TimeoutError(
+                f"queue {self.name} failed to drain for reshard handoff "
+                f"({self.ack.outstanding()} in flight)"
+            )
+        sweep_ack(self.ack, self._log, self.name)
+        return self.ack.ack_level
 
     # -- pump (remote-clock-gated) ------------------------------------
 
@@ -506,6 +529,8 @@ class TimerQueueStandbyProcessor:
             self._metrics.gauge("task_held", self.ack.held())
 
     def _process_due(self) -> None:
+        if self._paused.is_set():
+            return
         remote_now = self.gate.current_time()
         if remote_now <= 0:
             return  # no view of the remote clock yet: nothing is "due"

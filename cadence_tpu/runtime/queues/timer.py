@@ -81,6 +81,7 @@ class TimerQueueProcessor:
             engine.domains, getattr(engine, "cluster_metadata", None)
         )
         self._stopped = threading.Event()
+        self._paused = threading.Event()  # reshard fence: intake off
         self._pool = ThreadPoolExecutor(
             max_workers=worker_count, thread_name_prefix=f"timer-{shard.shard_id}"
         )
@@ -105,11 +106,20 @@ class TimerQueueProcessor:
         self.gate.update(0)
         self._pool.shutdown(wait=False)
 
-    def drain(self, timeout_s: float = 5.0) -> bool:
+    def drain(self, timeout_s: float = 5.0, *, deadline=None) -> bool:
         import time
 
-        deadline = time.monotonic() + timeout_s
+        if deadline is None:
+            deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            if self._paused.is_set():
+                # reshard fence: quiescent once nothing is in flight —
+                # due-but-unread timers stay in the store and move to
+                # the new owner past the recorded watermark
+                if self.ack.outstanding() == 0:
+                    return True
+                time.sleep(0.01)
+                continue
             now = self.shard.now()
             batch = self.shard.persistence.execution.get_timer_tasks(
                 self.shard.shard_id, self.ack.ack_level[0], now, 1
@@ -118,6 +128,27 @@ class TimerQueueProcessor:
                 return True
             time.sleep(0.01)
         return False
+
+    # -- reshard fence -------------------------------------------------
+
+    def pause_intake(self) -> None:
+        self._paused.set()
+
+    def resume_intake(self) -> None:
+        self._paused.clear()
+        self.gate.update(0)
+
+    def fence_drain(self, deadline: float):
+        """Pause intake, drain in-flight timers, return the (ts, id)
+        ack watermark (see QueueProcessorBase.fence_drain)."""
+        self.pause_intake()
+        if not self.drain(deadline=deadline):
+            raise TimeoutError(
+                f"queue {self.name} failed to drain for reshard handoff "
+                f"({self.ack.outstanding()} in flight)"
+            )
+        sweep_ack(self.ack, self._log, self.name)
+        return self.ack.ack_level
 
     # -- pump ----------------------------------------------------------
 
@@ -135,6 +166,8 @@ class TimerQueueProcessor:
             self._metrics.gauge("task_held", self.ack.held())
 
     def _process_due(self) -> None:
+        if self._paused.is_set():
+            return
         now = self.shard.now()
         # begin() BEFORE reading the ack level: a rewind between the
         # two bumps the generation and invalidates this scan's store

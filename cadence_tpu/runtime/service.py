@@ -74,6 +74,9 @@ class HistoryService:
         # every shard's state rebuilder resumes replays from durable
         # snapshots and writes fresh ones. None = cold rebuilds only.
         self.checkpoints = checkpoints
+        # config.ReshardingConfig (`resharding:` section) — read by the
+        # admin reshard verbs; None = defaults (enabled)
+        self.resharding_config = None
         self._log = get_logger(
             "cadence_tpu.history.service", host=monitor.self_identity
         )

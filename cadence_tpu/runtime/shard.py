@@ -14,7 +14,10 @@ from typing import Dict, Optional
 
 from cadence_tpu.utils.clock import RealTimeSource, TimeSource
 
-from .persistence.errors import EntityNotExistsError
+from .persistence.errors import (
+    EntityNotExistsError,
+    ShardOwnershipLostError,
+)
 from .persistence.interfaces import PersistenceBundle
 from .persistence.records import ShardInfo
 
@@ -37,6 +40,7 @@ class ShardContext:
         self._lock = threading.RLock()
         self._remote_cluster_time: dict = {}
         self._remote_time_listeners: list = []
+        self._fenced = False
         self._info = self._acquire()
         self._next_task_seq = 0
 
@@ -48,16 +52,75 @@ class ShardContext:
         except EntityNotExistsError:
             info = ShardInfo(shard_id=self.shard_id, range_id=0)
             self.persistence.shard.create_shard(info)
-        prev = info.range_id
-        info.range_id += 1
         info.owner = self.owner
-        self.persistence.shard.update_shard(info, previous_range_id=prev)
+        self._bump_range_with_retry(info)
         return info
+
+    def _bump_range_with_retry(self, info: ShardInfo) -> None:
+        """Bump ``info.range_id`` durably, surviving the torn-write
+        reality: a bump whose ack was lost LANDED — re-reading the row
+        and seeing our bump (same range, our owner) IS success, and a
+        transient error simply retries. A bump by someone ELSE means
+        the shard moved mid-acquire: re-bump from their lease so our
+        writes still fence theirs (last-acquirer-wins, exactly the
+        reference's steal semantics)."""
+        last_exc = None
+        for _ in range(4):
+            prev = info.range_id
+            info.range_id = prev + 1
+            try:
+                self.persistence.shard.update_shard(
+                    info, previous_range_id=prev
+                )
+                return
+            except Exception as e:
+                last_exc = e
+                try:
+                    stored = self.persistence.shard.get_shard(self.shard_id)
+                except Exception:
+                    info.range_id = prev
+                    continue
+                if (
+                    stored.range_id == info.range_id
+                    and stored.owner == info.owner
+                ):
+                    return  # our torn write landed
+                # someone else's lease (or a stale read): adopt and retry
+                info.__dict__.update(stored.__dict__)
+                info.owner = self.owner
+        raise last_exc
 
     @property
     def range_id(self) -> int:
+        """The current lease for stamping writes. Raises once the shard
+        is fenced for a reshard handoff: the context bumped its OWN
+        lease, so only an explicit refusal stops it from minting valid
+        writes against a shard that is being moved (clients retry
+        through the ring and land on the new owner after the flip)."""
         with self._lock:
+            if self._fenced:
+                raise ShardOwnershipLostError(
+                    self.shard_id, f"shard {self.shard_id} fenced for reshard"
+                )
             return self._info.range_id
+
+    @property
+    def fenced(self) -> bool:
+        with self._lock:
+            return self._fenced
+
+    def fence(self) -> None:
+        """Reshard handoff step (2): bump the lease (anything still
+        holding the old range_id fences at the store — a stolen shard
+        can never mint regressing task IDs) and refuse all further
+        writes/task-ID mints from THIS context. Idempotent, and it
+        survives torn lease writes (chaos on persistence.shard)."""
+        with self._lock:
+            if self._fenced:
+                return
+            self._bump_range_with_retry(self._info)
+            self._next_task_seq = 0
+            self._fenced = True
 
     def renew_range(self) -> None:
         """Bump the lease (new task-ID block; fences older owners)."""
@@ -73,6 +136,10 @@ class ShardContext:
 
     def next_task_id(self) -> int:
         with self._lock:
+            if self._fenced:
+                raise ShardOwnershipLostError(
+                    self.shard_id, f"shard {self.shard_id} fenced for reshard"
+                )
             if self._next_task_seq >= BLOCK_SIZE:
                 self.renew_range()
             tid = (self._info.range_id << BLOCK_BITS) | self._next_task_seq
@@ -88,9 +155,22 @@ class ShardContext:
     # -- ack levels ---------------------------------------------------
 
     def _update(self) -> None:
-        self.persistence.shard.update_shard(
-            self._info, previous_range_id=self._info.range_id
-        )
+        """Persist ack-level/cursor state under the CURRENT lease.
+        Same-range writes are idempotent (the condition still matches
+        after a torn write lands), so transient store errors get a
+        bounded retry; a genuine fence (newer range) surfaces."""
+        last_exc = None
+        for _ in range(3):
+            try:
+                self.persistence.shard.update_shard(
+                    self._info, previous_range_id=self._info.range_id
+                )
+                return
+            except ShardOwnershipLostError:
+                raise
+            except Exception as e:
+                last_exc = e
+        raise last_exc
 
     def get_transfer_ack_level(self) -> int:
         with self._lock:

@@ -103,6 +103,23 @@ CHECKPOINT_METRICS = (
     "events_replayed_saved",
 )
 
+# elastic resharding (runtime/resharding.py), emitted by the coordinator
+# under tags (layer=resharding): reshard_epoch gauges the committed
+# routing epoch, handoff_ms times each reconfiguration end-to-end,
+# checkpoints_shipped counts the snapshots flushed for the new owner,
+# and suffix_events_replayed counts the events the new owner actually
+# re-ran (total moved events minus events_replayed_saved — the
+# "checkpoints, not histories" shipping proof the chaos suite asserts).
+RESHARD_METRICS = (
+    "reshard_epoch",
+    "handoff_ms",
+    "reshard_pause_ms",
+    "checkpoints_shipped",
+    "suffix_events_replayed",
+    "reshard_commits",
+    "reshard_rollbacks",
+)
+
 # the standard per-operation triple
 REQUESTS = "requests"
 LATENCY = "latency"

@@ -60,6 +60,7 @@ class ScannerActivities:
         idle_task_list_age_s: float = 3600.0,
         now=time.time,
         matching=None,
+        shard_ids=None,
     ) -> None:
         self.tasks = task_manager
         self.history = history_manager
@@ -67,6 +68,12 @@ class ScannerActivities:
         # optional: consulted for live pollers before deleting a list
         self.matching = matching
         self.num_shards = num_shards
+        # live shard-id provider (elastic resharding: a split mints ids
+        # beyond the boot-time count, and a run moved to the new shard
+        # MUST be in the live set or the history scavenger would
+        # classify its tree orphaned and destroy it). None = the static
+        # range(num_shards) of a never-resharded cluster.
+        self._shard_ids = shard_ids
         self.idle_age = idle_task_list_age_s
         self.now = now
         # trees seen orphaned on the previous scavenge pass
@@ -168,7 +175,11 @@ class ScannerActivities:
         from cadence_tpu.runtime.persistence.records import BranchToken
 
         live = set()
-        for shard_id in range(self.num_shards):
+        shard_ids = (
+            self._shard_ids() if self._shard_ids is not None
+            else range(self.num_shards)
+        )
+        for shard_id in shard_ids:
             # fail-SAFE: any read error aborts this scavenge pass. An
             # incomplete live set is indistinguishable from "orphan" —
             # e.g. a reset run whose tree id we failed to read would be
@@ -195,11 +206,11 @@ class ScannerActivities:
 
 def build_scanner_worker(
     frontend, task_manager, history_manager=None, execution_manager=None,
-    num_shards: int = 0, **kwargs,
+    num_shards: int = 0, shard_ids=None, **kwargs,
 ) -> Worker:
     acts = ScannerActivities(
         task_manager, history_manager, execution_manager,
-        num_shards=num_shards, **kwargs,
+        num_shards=num_shards, shard_ids=shard_ids, **kwargs,
     )
     w = Worker(frontend, SYSTEM_DOMAIN, SCANNER_TASK_LIST,
                identity="scanner")

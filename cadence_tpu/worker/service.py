@@ -58,6 +58,13 @@ class WorkerService:
                 build_scanner_worker(
                     frontend, persistence.task, persistence.history,
                     persistence.execution, num_shards=num_shards,
+                    # live ids, not the boot-time count: after a shard
+                    # split the scavenger must count the new shard's
+                    # runs as live or it would destroy their histories
+                    shard_ids=(
+                        history_service.controller.shard_ids
+                        if history_service is not None else None
+                    ),
                     matching=frontend.matching if hasattr(
                         frontend, "matching"
                     ) else None,

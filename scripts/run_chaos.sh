@@ -7,6 +7,10 @@
 #   scripts/run_chaos.sh                 # fixed seed 1234
 #   CHAOS_SEED=7 scripts/run_chaos.sh    # one specific seed
 #   CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh   # seed sweep
+#   CHAOS_RESHARD=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # reshard-only sweep: split/merge under write faults, host
+#       # kill mid-handoff, rollback on a failed plan — every seed
+#       # re-proves byte-identical replay across the reconfiguration
 #
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
@@ -14,11 +18,19 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
+FILTER=()
+if [[ -n "${CHAOS_RESHARD:-}" ]]; then
+    FILTER=(-k TestReshardChaos)
+fi
+
 run_one() {
     local seed="$1"; shift
     echo "=== chaos suite, seed ${seed} ==="
+    # --runslow: the sweep runs the FULL family, including the
+    # slow-marked members tier-1 leaves out for wall-clock budget
     CHAOS_SEED="${seed}" python -m pytest tests/test_chaos_recovery.py \
-        -q -m chaos -p no:cacheprovider "$@"
+        -q -m chaos --runslow -p no:cacheprovider \
+        ${FILTER[@]+"${FILTER[@]}"} "$@"
 }
 
 if [[ -n "${CHAOS_SEEDS:-}" ]]; then

@@ -82,6 +82,23 @@ def test_smoke_emits_one_json_record():
         assert key in warm, f"rebuild_warm lacks {key}"
     assert warm["suffix_frac"] < 1.0, warm["suffix_frac"]
     assert warm["checkpoint_hit_rate"] > 0, warm["checkpoint_hit_rate"]
+    # the elastic-resharding contract: a live split committed mid-load,
+    # with the handoff pause (write-unavailability window) and the
+    # decision-latency probe percentiles as explicit record fields —
+    # absolute latencies are host-load noise at smoke scale, so only
+    # the record shape + commit + a nonzero sustained rate are pinned
+    live = out["configs"]["reshard_live"]
+    for key in ("steady_rate_wf_per_sec", "workflows_completed",
+                "start_p50_ms", "start_p99_ms", "during_handoff",
+                "handoff"):
+        assert key in live, f"reshard_live lacks {key}"
+    assert live["steady_rate_wf_per_sec"] > 0, live
+    assert live["handoff"]["state"] == "COMMITTED", live["handoff"]
+    assert live["handoff"]["epoch"] >= 1
+    assert live["handoff"]["pause_ms"] >= 0
+    assert live["handoff"]["moved_workflows"] > 0
+    for key in ("samples", "p50_ms", "p99_ms", "max_ms"):
+        assert key in live["during_handoff"], live["during_handoff"]
 
 
 def test_watchdog_still_yields_parseable_record():

@@ -74,9 +74,16 @@ TL = "chaos-tl"
 class ChaosBox:
     """Frontend→matching→history with a frozen clock and a pinned poll
     nonce, optionally fault-injected — two runs of the same workload
-    produce byte-identical histories unless a fault breaks recovery."""
+    produce byte-identical histories unless a fault breaks recovery.
 
-    def __init__(self, faults=None):
+    ``hosts`` > 1 builds an in-process multi-host cluster: one
+    HistoryService per host over the SAME bundle, each with its own
+    monitor whose history ring lists every host (the reshard chaos
+    family kills hosts mid-handoff)."""
+
+    def __init__(self, faults=None, num_shards=1, hosts=1):
+        from cadence_tpu.runtime.membership import Monitor
+
         self.metrics = Scope()
         self.persistence = create_memory_bundle()
         if faults is not None:
@@ -87,13 +94,27 @@ class ChaosBox:
             self.persistence.metadata, ClusterMetadata()
         )
         self.domains = DomainCache(self.persistence.metadata)
-        self.history = HistoryService(
-            1, self.persistence, self.domains,
-            single_host_monitor("chaos-host"),
-            time_source=FakeTimeSource(),
-            metrics=self.metrics, faults=faults,
-        )
-        hc = HistoryClient(self.history.controller)
+        self.clock = FakeTimeSource()
+        host_ids = [f"chaos-host-{i}" for i in range(hosts)]
+        self.services = []
+        controllers = {}
+        for ident in host_ids:
+            if hosts == 1:
+                monitor = single_host_monitor(ident)
+            else:
+                monitor = Monitor(self_identity=ident)
+                for service in Monitor.SERVICES:
+                    monitor.resolver(service).set_hosts(list(host_ids))
+            svc = HistoryService(
+                num_shards, self.persistence, self.domains, monitor,
+                time_source=self.clock,
+                metrics=self.metrics, faults=faults,
+            )
+            self.services.append(svc)
+            controllers[ident] = svc.controller
+        self.history = self.services[0]
+        hc = HistoryClient(controllers)
+        self.history_client = hc
         self.matching = MatchingEngine(
             self.persistence.task, hc,
             poll_request_id_fn=(
@@ -101,15 +122,41 @@ class ChaosBox:
             ),
         )
         mc = MatchingClient(self.matching)
-        self.history.wire(mc, hc)
-        self.history.start()
+        for svc in self.services:
+            svc.wire(mc, hc)
+            svc.start()
         self.frontend = WorkflowHandler(
             self.domain_handler, self.domains, hc, mc
         )
         self.domain_handler.register_domain(DOMAIN)
 
+    def coordinator(self, **kwargs):
+        from cadence_tpu.runtime.resharding import ReshardCoordinator
+
+        return ReshardCoordinator(
+            self.persistence,
+            [svc.controller for svc in self.services],
+            metrics=self.metrics, **kwargs,
+        )
+
+    def kill_host(self, index):
+        """Hard-kill one host: its engines stop and every surviving
+        ring evicts it (what the failure detector does on probe
+        misses)."""
+        dead = self.services[index]
+        ident = dead.monitor.self_identity
+        self.services = [
+            s for i, s in enumerate(self.services) if i != index
+        ]
+        dead.stop()
+        self.history_client.remove_host(ident)
+        for svc in self.services:
+            svc.monitor.leave("history", ident)
+        return dead
+
     def stop(self):
-        self.history.stop()
+        for svc in self.services:
+            svc.stop()
         self.matching.shutdown()
 
 
@@ -706,3 +753,266 @@ class TestCheckpointChaos:
             assert mutable_state_to_snapshot(h) == \
                 mutable_state_to_snapshot(w)
         assert scope.registry.counter_value("checkpoint_hit") == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic resharding chaos family (runtime/resharding.py)
+# ---------------------------------------------------------------------------
+
+
+def _drive_concurrent(box, workflow_ids, mid=None, timeout_s=60.0):
+    """Start every workflow, fire ``mid()`` while they are in flight,
+    wait for all to complete; returns canonical history JSON per id.
+    The SAME driver produces the clean baseline — concurrency is part
+    of the workload, not a nondeterminism source (frozen clock, pinned
+    poll nonce)."""
+    w = Worker(box.frontend, DOMAIN, TL, identity="chaos-worker",
+               sticky=False)
+    w.register_workflow("chaos-wf", _chained_doubler)
+    w.register_activity("double", lambda inp: inp * 2)
+    w.start()
+    try:
+        runs = {}
+        for wid in workflow_ids:
+            runs[wid] = box.frontend.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain=DOMAIN, workflow_id=wid,
+                    workflow_type="chaos-wf", task_list=TL, input=b"x",
+                    request_id=f"req-{wid}",
+                    execution_start_to_close_timeout_seconds=60,
+                )
+            )
+        if mid is not None:
+            mid()
+        histories = []
+        deadline = time.monotonic() + timeout_s
+        for wid in workflow_ids:
+            while time.monotonic() < deadline:
+                d = box.frontend.describe_workflow_execution(
+                    DOMAIN, wid, runs[wid]
+                )
+                if not d.is_running:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"workflow {wid} did not complete")
+            events, _ = box.frontend.get_workflow_execution_history(
+                DOMAIN, wid, runs[wid]
+            )
+            histories.append(json.dumps(
+                [e.to_dict() for e in events], sort_keys=True, default=repr
+            ))
+        return histories
+    finally:
+        w.stop()
+
+
+_RESHARD_WIDS = [f"rs-wf-{i}" for i in range(5)]
+_RESHARD_CLEAN: list = []  # per-process memo: identical workload/driver
+
+
+class TestReshardChaos:
+    """The ROADMAP's reshard scenario family: split/merge executed
+    mid-traffic under ≥10% injected write faults, host kill
+    mid-handoff, rollback on a failed plan — with the differential
+    byte-identical-replay guarantee held across every reconfiguration
+    and handoff shipping checkpoints + suffixes only (asserted via the
+    events_replayed_saved metric, never assumed)."""
+
+    def _clean_histories(self):
+        """Fault-free static-topology baseline, computed once per
+        process (every test drives the identical workload through the
+        identical concurrent driver)."""
+        if not _RESHARD_CLEAN:
+            box = ChaosBox(num_shards=2)
+            try:
+                _RESHARD_CLEAN.extend(_drive_concurrent(box, _RESHARD_WIDS))
+            finally:
+                box.stop()
+        return list(_RESHARD_CLEAN)
+
+    def test_split_then_merge_under_write_faults_byte_identical(self):
+        """A split AND a merge executed while the doubler workload runs
+        under the standard ≥10% write-fault storm: every workflow
+        completes, no queue task is lost or double-applied (a lost task
+        stalls a workflow, a duplicate changes its bytes), and every
+        history is byte-identical to the fault-free static-topology
+        run."""
+        clean = self._clean_histories()
+
+        sched = _write_fault_schedule(CHAOS_SEED)
+        box = ChaosBox(faults=sched, num_shards=2)
+        plans = []
+
+        def mid():
+            coord = box.coordinator()
+            plans.append(coord.split(0))
+            plans.append(coord.merge(2, 0))
+
+        try:
+            chaos = _drive_concurrent(box, _RESHARD_WIDS, mid=mid)
+            status = box.services[0].controller.describe()
+        finally:
+            box.stop()
+
+        assert [p.state for p in plans] == ["COMMITTED", "COMMITTED"]
+        assert plans[0].kind == "split" and plans[1].kind == "merge"
+        assert status["reshard_epoch"] == 2
+        assert sched.injected_total() >= 5, sched.snapshot()
+        for wid, a, b in zip(_RESHARD_WIDS, clean, chaos):
+            assert a == b, f"history for {wid} diverged across reshard"
+
+    def test_handoff_ships_checkpoints_and_suffixes_only(self):
+        """The no-full-history-shipping proof: the handoff snapshots
+        every OPEN workflow leaving the split shard, and the new owner
+        rehydrates them from those ReplayCheckpoints —
+        events_replayed_saved covers every open moved event and zero
+        suffix events re-replay on a quiesced handoff (under live
+        traffic the suffix covers only post-flush writes). Closed runs
+        move as rows and are never flushed (nobody replays them hot)."""
+        from cadence_tpu.runtime.resharding import ShardMap
+
+        old_map = ShardMap.initial(2)
+        new_map, new_id = old_map.split(0)
+        # workflow ids that the split moves 0 -> new shard
+        moving_wids = []
+        i = 0
+        while len(moving_wids) < 3:
+            wid = f"open-{i}"
+            if (old_map.shard_for(wid) == 0
+                    and new_map.shard_for(wid) == new_id):
+                moving_wids.append(wid)
+            i += 1
+
+        box = ChaosBox(num_shards=2)
+        try:
+            _drive_concurrent(box, _RESHARD_WIDS)  # a closed population
+            # open, in-flight workflows (no worker running: they hold a
+            # scheduled decision task — the "hot" state a reshard ships)
+            for wid in moving_wids:
+                box.frontend.start_workflow_execution(StartWorkflowRequest(
+                    domain=DOMAIN, workflow_id=wid,
+                    workflow_type="chaos-wf", task_list=TL, input=b"x",
+                    request_id=f"req-{wid}",
+                    execution_start_to_close_timeout_seconds=300,
+                ))
+            coord = box.coordinator()
+            plan = coord.split(0)
+            assert plan.state == "COMMITTED"
+            assert plan.moved_workflows >= len(moving_wids)
+            assert plan.checkpoints_shipped >= len(moving_wids), (
+                "every open moved workflow must ship a checkpoint"
+            )
+            assert plan.suffix_events_replayed == 0, (
+                "quiesced handoff must replay no suffix events"
+            )
+            saved = box.metrics.registry.counter_value(
+                "events_replayed_saved"
+            )
+            assert saved and saved > 0, (
+                "checkpoint shipping must be observable in "
+                "events_replayed_saved"
+            )
+        finally:
+            box.stop()
+
+    @pytest.mark.slow
+    def test_host_kill_mid_handoff_traffic_recovers(self):
+        """Two hosts; the one NOT running the coordinator dies right
+        after the fence step (the worst window: shards quiesced, rows
+        mid-move). The handoff still commits, the survivor re-acquires
+        every shard including the dead host's, and the full workload
+        completes byte-identically to the clean static run.
+
+        slow-marked (still chaos-marked: every run_chaos.sh sweep runs
+        it): the two-host box + kill/re-acquire churn is the family's
+        most wall-clock-hungry member and tier-1's budget is shared."""
+        clean = self._clean_histories()
+
+        box = ChaosBox(num_shards=2, hosts=2)
+        killed = []
+
+        def on_step(step):
+            if step == "fenced" and not killed:
+                box.kill_host(1)
+                killed.append(True)
+
+        plans = []
+
+        def mid():
+            coord = box.coordinator(on_step=on_step)
+            plans.append(coord.split(0))
+            # the dead host is gone from the coordinator's view too
+            coord.controllers = [
+                s.controller for s in box.services
+            ]
+
+        try:
+            chaos = _drive_concurrent(box, _RESHARD_WIDS, mid=mid)
+            owned = box.services[0].controller.owned_shards()
+        finally:
+            box.stop()
+
+        assert killed, "the kill hook never fired"
+        assert plans[0].state == "COMMITTED"
+        assert owned == [0, 1, 2], (
+            "survivor must own every shard incl. the split target"
+        )
+        for wid, a, b in zip(_RESHARD_WIDS, clean, chaos):
+            assert a == b, f"history for {wid} diverged after host kill"
+
+    def test_failed_plan_rolls_back_then_retry_succeeds(self):
+        """A write fault on the COMMIT record (the epoch LWT write)
+        must roll the whole handoff back — old epoch, rows at home,
+        fences lifted (no regression: rollback re-acquires under fresh
+        leases) — and traffic keeps completing; a later fault-free
+        retry commits."""
+        from cadence_tpu.runtime.resharding import ReshardError
+
+        # write 1 = PREPARED, 2 = FENCED, 3.. = COMMIT <- faulted past
+        # the coordinator's transient-retry budget (3), so the handoff
+        # must give up; the ABORT record (call 6) goes through
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.shard",
+                      method="set_reshard_state",
+                      after_calls=2, max_faults=3, probability=1.0,
+                      error="PersistenceError"),
+        ])
+        box = ChaosBox(faults=sched, num_shards=2)
+        outcomes = []
+
+        def mid():
+            coord = box.coordinator()
+            epoch_before = coord.current_map().epoch
+            range_before = box.persistence.shard.get_shard(0).range_id
+            with pytest.raises(ReshardError):
+                coord.split(0)
+            from cadence_tpu.runtime.resharding import load_reshard_state
+
+            _, plan = load_reshard_state(box.persistence.shard)
+            outcomes.append((
+                plan.state, coord.current_map().epoch, epoch_before,
+                box.persistence.shard.get_shard(0).range_id, range_before,
+            ))
+            retry = coord.split(0)
+            outcomes.append(retry.state)
+
+        try:
+            chaos = _drive_concurrent(box, _RESHARD_WIDS, mid=mid)
+        finally:
+            box.stop()
+
+        (state, epoch_after, epoch_before, range_after, range_before), \
+            retry_state = outcomes
+        assert state == "ABORTED"
+        assert epoch_after == epoch_before, "epoch must not advance"
+        assert range_after > range_before, (
+            "rollback must never regress the fence (lease only bumps)"
+        )
+        assert retry_state == "COMMITTED"
+        assert sched.injected_total() == 3
+        # the aborted handoff + retry cost nothing: workload intact
+        for wid, a, b in zip(
+            _RESHARD_WIDS, self._clean_histories(), chaos
+        ):
+            assert a == b, f"history for {wid} diverged after rollback"

@@ -391,3 +391,52 @@ def test_assoc_depth_scaling_sublinear():
         f"assoc wall time not sublinear in depth: "
         f"{assoc_1k * 1e3:.1f} ms @1k -> {assoc_8k * 1e3:.1f} ms @8k"
     )
+
+
+def test_fuzz_shallow_lanes_assoc_parity():
+    """Shallow lane-packed batches (many short histories per lane) —
+    the shape on which the assoc path's provenance scatters used to
+    regress and auto held lanes back. Now both assoc impls must be
+    byte-identical to the sequential packed scan, AND the dispatcher's
+    scan_mode="auto" lane-packed pipeline must route them through the
+    associative kernel with identical bytes (the former gate held auto
+    on the sequential scan)."""
+    from cadence_tpu.ops import assoc
+    from cadence_tpu.ops.dispatch import replay_stream
+    from cadence_tpu.ops.pack import pack_lanes
+    from cadence_tpu.ops.replay import replay_packed
+
+    histories = []
+    for seed in range(40):
+        fz = HistoryFuzzer(seed=7000 + seed, caps=CAPS)
+        histories.append((
+            f"wf-{seed}", f"run-{seed}",
+            fz.generate(target_events=6 + seed % 7, close=seed % 2 == 0),
+        ))
+
+    lanes = pack_lanes(histories, caps=CAPS, target_lane_len=96)
+    assert max(len(s) for s in lanes.lane_segments) > 1, (
+        "not actually shallow-packed: need several histories per lane"
+    )
+    want = replay_packed(lanes, scan_mode="scan")
+    for impl in ("resolve", "segscan"):
+        got = assoc.replay_assoc_lanes(lanes, impl=impl)
+        bad = _state_fields_equal(got, want)
+        assert bad is None, (
+            f"shallow lanes assoc[{impl}] != scan in field {bad}"
+        )
+
+    # dispatcher auto now routes shallow lane-packed batches to assoc
+    import jax
+    import numpy as np
+
+    auto = replay_stream(histories, caps=CAPS, batch_size=40,
+                         lane_pack=True, lane_len=96)
+    scan = replay_stream(histories, caps=CAPS, batch_size=40,
+                         lane_pack=True, lane_len=96, scan_mode="scan")
+    assert len(auto) == len(scan) == 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(auto[0][1]),
+        jax.tree_util.tree_leaves(scan[0][1]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -498,3 +498,154 @@ class TestHistoryTrees:
             bundle.execution.create_workflow_execution(
                 9999, 1, 0, snap
             )
+
+
+class TestReshardState:
+    """Singleton routing-epoch row (elastic resharding write-ahead
+    record) — LWT semantics identical on every backend."""
+
+    def test_absent_reads_none_and_writes_from_epoch_zero(self, bundle):
+        assert bundle.shard.get_reshard_state() is None
+        bundle.shard.set_reshard_state(1, '{"m": 1}', previous_epoch=0)
+        assert bundle.shard.get_reshard_state() == (1, '{"m": 1}')
+
+    def test_epoch_lwt_rejects_stale_writer(self, bundle):
+        bundle.shard.set_reshard_state(1, "a", previous_epoch=0)
+        with pytest.raises(ConditionFailedError):
+            bundle.shard.set_reshard_state(2, "b", previous_epoch=0)
+        # in-place update under the SAME epoch (plan state transitions)
+        bundle.shard.set_reshard_state(1, "a2", previous_epoch=1)
+        bundle.shard.set_reshard_state(2, "b", previous_epoch=1)
+        assert bundle.shard.get_reshard_state() == (2, "b")
+
+
+class TestReshardMove:
+    """reshard_extract / reshard_install: the handoff's row mover —
+    atomic, watermark-aware, and exactly-once on task identity."""
+
+    TARGET = 7
+
+    def _seed(self, bundle, wf="wf-move", run="run1"):
+        bundle.shard.create_shard(
+            ShardInfo(shard_id=self.TARGET, range_id=5)
+        )
+        snap = make_snapshot(wf=wf, run=run, tasks=True)
+        bundle.execution.create_workflow_execution(
+            SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, snap
+        )
+        return snap
+
+    def test_extract_install_roundtrip_moves_everything(self, bundle):
+        self._seed(bundle)
+        ext = bundle.execution.reshard_extract(
+            SHARD, ["wf-move"], transfer_watermark=0,
+            timer_watermark=(0, 0), delete=True,
+        )
+        assert len(ext["executions"]) == 1
+        assert len(ext["currents"]) == 1
+        assert len(ext["transfer"]) == 1 and len(ext["timers"]) == 1
+        # gone from the source
+        with pytest.raises(EntityNotExistsError):
+            bundle.execution.get_workflow_execution(
+                SHARD, "dom", "wf-move", "run1"
+            )
+        assert bundle.execution.get_transfer_tasks(SHARD, 0, 1 << 60, 10) == []
+
+        ids = iter(range(1000, 1010))
+        bundle.execution.reshard_install(
+            self.TARGET, 5, ext, lambda: next(ids)
+        )
+        resp = bundle.execution.get_workflow_execution(
+            self.TARGET, "dom", "wf-move", "run1"
+        )
+        assert resp.next_event_id == 3
+        cur = bundle.execution.get_current_execution(
+            self.TARGET, "dom", "wf-move"
+        )
+        assert cur.run_id == "run1"
+        moved = bundle.execution.get_transfer_tasks(
+            self.TARGET, 0, 1 << 60, 10
+        )
+        # re-minted ids from the target's sequencer; same task identity
+        assert [t.task_id for t in moved] == [1000]
+        assert moved[0].workflow_id == "wf-move"
+        timers = bundle.execution.get_timer_tasks(
+            self.TARGET, 0, 1 << 62, 10
+        )
+        assert len(timers) == 1 and timers[0].task_id == 1001
+
+    def test_copy_then_purge_is_crash_safe(self, bundle):
+        """delete=False extract is a pure read; purge removes exactly
+        the named rows (idempotent) — the coordinator's copy-then-purge
+        move never has a window with the rows on NEITHER shard."""
+        self._seed(bundle)
+        ext = bundle.execution.reshard_extract(
+            SHARD, ["wf-move"], transfer_watermark=0,
+            timer_watermark=(0, 0),
+        )
+        assert len(ext["executions"]) == 1
+        # source intact after the read
+        bundle.execution.get_workflow_execution(
+            SHARD, "dom", "wf-move", "run1"
+        )
+        ids = iter(range(2000, 2010))
+        bundle.execution.reshard_install(
+            self.TARGET, 5, ext, lambda: next(ids)
+        )
+        # both copies exist (the crash window); purge resolves it
+        bundle.execution.reshard_purge(SHARD, ext)
+        with pytest.raises(EntityNotExistsError):
+            bundle.execution.get_workflow_execution(
+                SHARD, "dom", "wf-move", "run1"
+            )
+        assert bundle.execution.get_transfer_tasks(
+            SHARD, 0, 1 << 60, 10
+        ) == []
+        bundle.execution.reshard_purge(SHARD, ext)  # idempotent
+        bundle.execution.get_workflow_execution(
+            self.TARGET, "dom", "wf-move", "run1"
+        )
+
+    def test_watermarks_leave_completed_tasks_behind(self, bundle):
+        self._seed(bundle)
+        # transfer task id is 100 (make_snapshot): a watermark at/above
+        # it means the task was durably completed — it must NOT move
+        ext = bundle.execution.reshard_extract(
+            SHARD, ["wf-move"], transfer_watermark=100,
+            timer_watermark=(1 << 62, 0),
+        )
+        assert ext["transfer"] == [] and ext["timers"] == []
+        assert len(ext["executions"]) == 1
+
+    def test_unlisted_workflows_stay(self, bundle):
+        self._seed(bundle)
+        other = make_snapshot(wf="wf-stay", run="run2", tasks=True)
+        bundle.execution.create_workflow_execution(
+            SHARD, RANGE, CreateWorkflowMode.BRAND_NEW, other
+        )
+        ext = bundle.execution.reshard_extract(
+            SHARD, ["wf-move"], transfer_watermark=0,
+            timer_watermark=(0, 0), delete=True,
+        )
+        assert {e["workflow_id"] for e in ext["executions"]} == {"wf-move"}
+        # wf-stay untouched, tasks included
+        bundle.execution.get_workflow_execution(
+            SHARD, "dom", "wf-stay", "run2"
+        )
+        remaining = bundle.execution.get_transfer_tasks(
+            SHARD, 0, 1 << 60, 10
+        )
+        assert {t.workflow_id for t in remaining} == {"wf-stay"}
+
+    def test_install_fenced_by_target_range(self, bundle):
+        self._seed(bundle)
+        ext = bundle.execution.reshard_extract(
+            SHARD, ["wf-move"], transfer_watermark=0,
+            timer_watermark=(0, 0), delete=True,
+        )
+        with pytest.raises(ShardOwnershipLostError):
+            bundle.execution.reshard_install(
+                self.TARGET, 4, ext, lambda: 1  # stale range_id
+            )
+        # all-or-nothing: nothing landed on the fenced target
+        assert bundle.execution.list_concrete_executions(self.TARGET) == []

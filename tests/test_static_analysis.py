@@ -667,6 +667,134 @@ class TestLockOrder:
         fs = _lock_findings(src)
         assert any("C.bad" in f.anchor and "_persist" in f.anchor for f in fs)
 
+    # -- cross-class lock propagation ----------------------------------
+
+    def test_cross_class_blocking_propagates(self):
+        """A non-self receiver's method resolved by name: the callee's
+        store I/O fires LOCK-CROSS-BLOCKING at the caller."""
+        src = textwrap.dedent("""
+            import threading
+
+            class Shard:
+                def fence_lease(self):
+                    self.persistence.shard.update_shard(1)
+
+            class Coordinator:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def handoff(self, handle):
+                    with self._lock:
+                        handle.shard.fence_lease()
+        """)
+        fs = _lock_findings(src)
+        hits = [f for f in fs if f.rule == "LOCK-CROSS-BLOCKING"]
+        assert len(hits) == 1, fs
+        assert "Coordinator.handoff" in hits[0].anchor
+        assert "fence_lease" in hits[0].anchor
+        assert "Shard.fence_lease" in hits[0].message
+
+    def test_cross_class_ambiguous_name_skipped(self):
+        """Two scope classes define the name and DISAGREE on blocking:
+        name resolution must not guess (no finding)."""
+        src = textwrap.dedent("""
+            import threading
+
+            class A:
+                def work(self):
+                    self.persistence.shard.update_shard(1)
+
+            class B:
+                def work(self):
+                    return 1
+
+            class Caller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def go(self, x):
+                    with self._lock:
+                        x.work()
+        """)
+        fs = _lock_findings(src)
+        assert not any(f.rule == "LOCK-CROSS-BLOCKING" for f in fs), fs
+
+    def test_cross_class_agreeing_candidates_fire(self):
+        """Several scope classes define the name but ALL block —
+        whichever instance it is, the caller stalls: fire."""
+        src = textwrap.dedent("""
+            import threading
+
+            class A:
+                def work(self):
+                    self.persistence.shard.update_shard(1)
+
+            class B:
+                def work(self):
+                    import time
+                    time.sleep(1)
+
+            class Caller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def go(self, x):
+                    with self._lock:
+                        x.work()
+        """)
+        fs = _lock_findings(src)
+        assert any(f.rule == "LOCK-CROSS-BLOCKING" for f in fs), fs
+
+    def test_cross_class_builtin_names_exempt(self):
+        """A scope class named ``append`` must not hijack list.append —
+        builtin container/protocol names never resolve cross-class."""
+        src = textwrap.dedent("""
+            import threading
+
+            class Writer:
+                def append(self):
+                    self.persistence.shard.update_shard(1)
+
+            class Caller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def go(self, items):
+                    with self._lock:
+                        items.append(1)
+        """)
+        fs = _lock_findings(src)
+        assert not any(f.rule == "LOCK-CROSS-BLOCKING" for f in fs), fs
+
+    def test_cross_class_inversion_fires(self):
+        """The callee's lock joins the caller's edge graph: A holds its
+        lock then takes B's (through b_hold()); B holds its lock then
+        takes A's (through a_hold()) — deadlock-capable, and invisible
+        to the in-class pass."""
+        src = textwrap.dedent("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._alock = threading.Lock()
+                def a_then_b(self, b):
+                    with self._alock:
+                        b.b_hold()
+                def a_hold(self):
+                    with self._alock:
+                        pass
+
+            class B:
+                def __init__(self):
+                    self._block = threading.Lock()
+                def b_then_a(self, a):
+                    with self._block:
+                        a.a_hold()
+                def b_hold(self):
+                    with self._block:
+                        pass
+        """)
+        fs = _lock_findings(src)
+        inv = [f for f in fs if f.rule == "LOCK-INVERSION"]
+        assert len(inv) == 1, fs
+        assert "A._alock" in inv[0].message and "B._block" in inv[0].message
+
 
 # --------------------------------------------------------------------------
 # the gate: clean tree against the checked-in baseline
