@@ -234,6 +234,9 @@ def start_services(
         )
         # admin reshard verbs read the section off the service
         history.resharding_config = cfg.resharding
+        # adaptive geo-replication knobs for the pull processors
+        # (consumed by enable_replication_from / _build_shard)
+        history.replication_config = cfg.replication
         out.history = history
 
     hc = RoutedHistoryClient(

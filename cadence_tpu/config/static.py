@@ -205,6 +205,44 @@ class ReshardingConfig:
 
 
 @dataclasses.dataclass
+class ReplicationConfig:
+    """Bandwidth-adaptive geo-replication (runtime/replication/
+    transport.py).
+
+    ``adaptive`` gates the whole transport: off, the consumer is the
+    pre-adaptive pure event-stream puller. ``hysteresis``/``minDwell``
+    damp the event-vs-snapshot mode controller (a switch requires the
+    challenger to win the cost model by the factor, that many decisions
+    in a row); ``minGapEvents`` floors the gap a snapshot may ever ship
+    for; ``snapshotBytesPrior`` seeds the cost model before the first
+    observed snapshot transfer. ``backoffMaxSeconds`` caps the pump's
+    jittered exponential retry backoff on failed cycles."""
+
+    adaptive: bool = True
+    hysteresis: float = 1.5
+    min_dwell: int = 2
+    min_gap_events: int = 32
+    snapshot_bytes_prior: float = 64 * 1024.0
+    backoff_max_s: float = 5.0
+
+    def validate(self) -> None:
+        if self.hysteresis < 1.0:
+            raise ConfigError("replication.hysteresis must be >= 1.0")
+        if self.min_dwell < 1:
+            raise ConfigError("replication.minDwell must be >= 1")
+        if self.min_gap_events < 1:
+            raise ConfigError("replication.minGapEvents must be >= 1")
+        if self.snapshot_bytes_prior <= 0:
+            raise ConfigError(
+                "replication.snapshotBytesPrior must be > 0"
+            )
+        if self.backoff_max_s <= 0:
+            raise ConfigError(
+                "replication.backoffMaxSeconds must be > 0"
+            )
+
+
+@dataclasses.dataclass
 class ServerConfig:
     persistence: PersistenceConfig = dataclasses.field(
         default_factory=PersistenceConfig
@@ -221,6 +259,9 @@ class ServerConfig:
     resharding: ReshardingConfig = dataclasses.field(
         default_factory=ReshardingConfig
     )
+    replication: ReplicationConfig = dataclasses.field(
+        default_factory=ReplicationConfig
+    )
     dynamicconfig_path: str = ""
     archival_dir: str = ""
 
@@ -230,6 +271,7 @@ class ServerConfig:
         self.chaos.validate()
         self.checkpoint.validate()
         self.resharding.validate()
+        self.replication.validate()
         for name in self.services:
             if name not in SERVICES:
                 raise ConfigError(f"services: unknown service '{name}'")
@@ -342,6 +384,17 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "drainTimeoutSeconds": "drain_timeout_s",
             "checkpointFlush": "checkpoint_flush",
         }, "resharding"))
+
+    repl = raw.pop("replication", None)
+    if repl:
+        cfg.replication = ReplicationConfig(**_take(repl, {
+            "adaptive": "adaptive",
+            "hysteresis": "hysteresis",
+            "minDwell": "min_dwell",
+            "minGapEvents": "min_gap_events",
+            "snapshotBytesPrior": "snapshot_bytes_prior",
+            "backoffMaxSeconds": "backoff_max_s",
+        }, "replication"))
 
     dc = raw.pop("dynamicConfig", None)
     if dc:

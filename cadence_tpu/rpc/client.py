@@ -131,6 +131,26 @@ class RemoteClusterRPCClient:
             domain_id, workflow_id, run_id, start_event_id, end_event_id
         )
 
+    # -- bandwidth-adaptive state transfer (replication/transport.py) --
+
+    def get_replication_backlog(
+        self, shard_id: int, last_retrieved_id: int
+    ):
+        """Per-run backlog spans past the cursor (no event payloads) —
+        the adaptive consumer's catch-up probe."""
+        return self._stub.get_replication_backlog(
+            shard_id, last_retrieved_id
+        )
+
+    def get_replication_checkpoint(
+        self, domain_id: str, workflow_id: str, run_id: str
+    ) -> bytes:
+        """Delta-compressed branch-tip ReplayCheckpoint (snapshot
+        shipping); b"" = no shippable snapshot."""
+        return self._stub.get_replication_checkpoint(
+            domain_id, workflow_id, run_id
+        )
+
     def close(self) -> None:
         self._stub.close()
 

@@ -1169,6 +1169,7 @@ class HistoryEngine:
                 ),
                 metrics=getattr(self, "metrics", None),
                 faults=getattr(self, "faults", None),
+                checkpoints=getattr(self, "checkpoints", None),
             )
         return self._replicator_queue
 
@@ -1181,34 +1182,47 @@ class HistoryEngine:
             cluster, last_retrieved_id
         )
 
+    def get_replication_backlog(self, last_retrieved_id: int):
+        """Per-run backlog spans past the cursor, no event payloads —
+        the adaptive consumer's catch-up probe."""
+        return self.replicator_queue.get_replication_backlog(
+            last_retrieved_id
+        )
+
+    def get_replication_checkpoint(
+        self, domain_id: str, workflow_id: str, run_id: str
+    ) -> bytes:
+        """Delta-compressed branch-tip ReplayCheckpoint for snapshot
+        shipping (b"" = no shippable snapshot; consumer falls back to
+        event shipping)."""
+        return self.replicator_queue.get_replication_checkpoint(
+            domain_id, workflow_id, run_id
+        )
+
     def get_workflow_history_raw(
         self, domain_id: str, workflow_id: str, run_id: str,
         start_event_id: int, end_event_id: int,
     ):
         """Raw history + version-history items for re-replication
         (reference: adminHandler GetWorkflowExecutionRawHistoryV2)."""
-        from ..persistence.records import BranchToken
+        from ..persistence.records import (
+            BranchToken,
+            current_version_history,
+        )
 
         resp = self.shard.persistence.execution.get_workflow_execution(
             self.shard.shard_id, domain_id, workflow_id, run_id
         )
-        snap = resp.snapshot or {}
-        vh_dict = snap.get("version_histories") or {}
-        histories = vh_dict.get("histories", [])
-        current = (
-            histories[vh_dict.get("current_index", 0)]
-            if histories
-            else {"items": [], "branch_token": ""}
-        )
+        token_str, item_pairs = current_version_history(resp.snapshot)
+        if not token_str:
+            token_str = (resp.snapshot or {}).get(
+                "execution_info", {}
+            ).get("branch_token", "")
+            if isinstance(token_str, bytes):
+                token_str = token_str.decode()
         items = [
-            {"event_id": e, "version": v} for e, v in current.get("items", [])
+            {"event_id": e, "version": v} for e, v in item_pairs
         ]
-        raw = snap.get("execution_info", {}).get("branch_token", "")
-        token_str = (
-            current.get("branch_token") or raw
-        )
-        if isinstance(token_str, bytes):
-            token_str = token_str.decode()
         branch = BranchToken.from_json(token_str)
         batches, _ = self.shard.persistence.history.read_history_branch(
             branch, start_event_id, end_event_id

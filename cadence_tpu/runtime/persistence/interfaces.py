@@ -65,6 +65,29 @@ class ShardManager:
         match — two coordinators can never both commit an epoch."""
         raise NotImplementedError
 
+    # -- adaptive geo-replication (runtime/replication/) ---------------
+
+    def get_replication_progress(
+        self, shard_id: int, cluster: str
+    ) -> Optional[Tuple[int, str]]:
+        """The consumer-side replication progress row for one
+        (shard, remote cluster) link: ``(version, blob)`` where the
+        blob carries the durably applied cursor + transport mode
+        (processor._progress_blob), or None when the link has never
+        persisted progress."""
+        raise NotImplementedError
+
+    def set_replication_progress(
+        self, shard_id: int, cluster: str, blob: str,
+        previous_version: int,
+    ) -> None:
+        """LWT on the stored version (an absent row reads as version
+        0); the stored version becomes ``previous_version + 1``. Raises
+        ConditionFailedError on mismatch — same torn-write-retry
+        discipline as ``set_reshard_state``: a retry that re-reads the
+        blob it meant to write treats the torn write as landed."""
+        raise NotImplementedError
+
 
 class ExecutionManager:
     """Per-shard workflow-execution store + transfer/timer/replication

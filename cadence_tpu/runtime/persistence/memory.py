@@ -51,6 +51,9 @@ class MemoryShardManager(I.ShardManager):
         self._shards: Dict[int, ShardInfo] = {}
         # singleton routing-epoch row: (epoch, blob) or None
         self._reshard_state: Optional[Tuple[int, str]] = None
+        # (shard_id, cluster) -> (version, blob): the consumer-side
+        # replication cursor/mode rows (adaptive geo-replication)
+        self._replication_progress: Dict[Tuple[int, str], Tuple[int, str]] = {}
         self._lock = threading.RLock()
 
     def create_shard(self, info: ShardInfo) -> None:
@@ -91,6 +94,29 @@ class MemoryShardManager(I.ShardManager):
                     f"reshard epoch {stored} != expected {previous_epoch}"
                 )
             self._reshard_state = (epoch, blob)
+
+    # -- adaptive geo-replication --------------------------------------
+
+    def get_replication_progress(
+        self, shard_id: int, cluster: str
+    ) -> Optional[Tuple[int, str]]:
+        with self._lock:
+            return self._replication_progress.get((shard_id, cluster))
+
+    def set_replication_progress(
+        self, shard_id: int, cluster: str, blob: str,
+        previous_version: int,
+    ) -> None:
+        with self._lock:
+            key = (shard_id, cluster)
+            row = self._replication_progress.get(key)
+            stored = row[0] if row else 0
+            if stored != previous_version:
+                raise ConditionFailedError(
+                    f"replication progress version {stored} != "
+                    f"expected {previous_version}"
+                )
+            self._replication_progress[key] = (previous_version + 1, blob)
 
 
 class MemoryExecutionManager(I.ExecutionManager):

@@ -218,3 +218,29 @@ class VisibilityRecord:
     history_length: int = 0
     memo: Dict[str, Any] = dataclasses.field(default_factory=dict)
     search_attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+# -- stored-snapshot helpers ----------------------------------------------
+
+
+def current_version_history(snapshot: Dict[str, Any]):
+    """Extract the CURRENT version history from a stored mutable-state
+    snapshot dict: ``(branch_token_str, [(event_id, version), ...])``,
+    with the execution_info branch token as the fallback when the
+    history carries none. One place owns the fiddly current_index /
+    bytes-vs-str / fallback dance (the raw-history read path, the
+    replication snapshot server) — returns ("", []) when the snapshot
+    has no version histories."""
+    snap = snapshot or {}
+    vh = snap.get("version_histories") or {}
+    histories = vh.get("histories", [])
+    if not histories:
+        return "", []
+    current = histories[vh.get("current_index", 0)]
+    token = current.get("branch_token") or snap.get(
+        "execution_info", {}
+    ).get("branch_token", "")
+    if isinstance(token, bytes):
+        token = token.decode()
+    items = [(int(e), int(v)) for e, v in current.get("items", [])]
+    return token, items

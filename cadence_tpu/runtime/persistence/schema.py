@@ -85,6 +85,13 @@ CREATE TABLE IF NOT EXISTS reshard_state (
   epoch INTEGER NOT NULL, blob TEXT NOT NULL);
 """
 
+_V5_REPLICATION_PROGRESS = """
+CREATE TABLE IF NOT EXISTS replication_progress (
+  shard_id INTEGER, cluster TEXT,
+  version INTEGER NOT NULL, blob TEXT NOT NULL,
+  PRIMARY KEY (shard_id, cluster));
+"""
+
 # (version, name, script) — append-only, like the reference's
 # schema/cassandra/cadence/versioned/ dirs
 MIGRATIONS: List[Tuple[int, str, str]] = [
@@ -92,6 +99,7 @@ MIGRATIONS: List[Tuple[int, str, str]] = [
     (2, "query indexes", _V2_QUERY_INDEXES),
     (3, "replay checkpoints", _V3_REPLAY_CHECKPOINTS),
     (4, "reshard state", _V4_RESHARD_STATE),
+    (5, "replication progress", _V5_REPLICATION_PROGRESS),
 ]
 
 CURRENT_SCHEMA_VERSION = MIGRATIONS[-1][0]

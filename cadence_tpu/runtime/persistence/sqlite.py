@@ -168,6 +168,38 @@ class SqliteShardManager(I.ShardManager):
                 (epoch, blob),
             )
 
+    # -- adaptive geo-replication --------------------------------------
+
+    def get_replication_progress(self, shard_id, cluster):
+        with self.db.txn() as c:
+            row = c.execute(
+                "SELECT version, blob FROM replication_progress "
+                "WHERE shard_id=? AND cluster=?",
+                (shard_id, cluster),
+            ).fetchone()
+        return (int(row[0]), row[1]) if row else None
+
+    def set_replication_progress(
+        self, shard_id, cluster, blob, previous_version
+    ):
+        with self.db.txn() as c:
+            row = c.execute(
+                "SELECT version FROM replication_progress "
+                "WHERE shard_id=? AND cluster=?",
+                (shard_id, cluster),
+            ).fetchone()
+            stored = int(row[0]) if row else 0
+            if stored != previous_version:
+                raise ConditionFailedError(
+                    f"replication progress version {stored} != "
+                    f"expected {previous_version}"
+                )
+            c.execute(
+                "INSERT OR REPLACE INTO replication_progress "
+                "VALUES (?,?,?,?)",
+                (shard_id, cluster, previous_version + 1, blob),
+            )
+
 
 class SqliteExecutionManager(I.ExecutionManager):
     def __init__(self, db: _Db) -> None:

@@ -17,6 +17,13 @@ from .rebuilder import StateRebuilder
 from .ndc import NDCHistoryReplicator
 from .processor import ReplicationTaskFetcher, ReplicationTaskProcessor
 from .rereplicator import HistoryRereplicator
+from .transport import (
+    MODE_EVENTS,
+    MODE_SNAPSHOT,
+    AdaptiveTransport,
+    LinkEstimator,
+    ReplicationModeController,
+)
 
 __all__ = [
     "HistoryTaskV2",
@@ -28,4 +35,9 @@ __all__ = [
     "ReplicationTaskFetcher",
     "ReplicationTaskProcessor",
     "HistoryRereplicator",
+    "MODE_EVENTS",
+    "MODE_SNAPSHOT",
+    "AdaptiveTransport",
+    "LinkEstimator",
+    "ReplicationModeController",
 ]

@@ -89,6 +89,11 @@ class NDCHistoryReplicator:
         self._is_active_locally = is_active_locally or (lambda domain_id: False)
         self._task_notifier = task_notifier
         self._timer_notifier = timer_notifier
+        # raw metrics handle for the snapshot-shipping install plane (the
+        # transient rebuilder it builds must emit events_replayed_saved
+        # into the same registry as the engine-wired one)
+        self._raw_metrics = metrics
+        self._transient_snapshots = None
 
     def _resolve_domain(self, name: str) -> str:
         if not name:
@@ -250,7 +255,9 @@ class NDCHistoryReplicator:
             ms.execution_info.branch_token
         )
 
-        mode, prev_run_id = self._create_mode(task)
+        mode, prev_run_id = self._create_mode(
+            task.domain_id, task.workflow_id, task.version
+        )
         snapshot = self._snapshot(
             ms, sb.transfer_tasks, sb.timer_tasks, zombie=(
                 mode == CreateWorkflowMode.ZOMBIE
@@ -270,19 +277,23 @@ class NDCHistoryReplicator:
         ctx._condition = ms.next_event_id
         self._notify(sb)
 
-    def _create_mode(self, task: HistoryTaskV2) -> Tuple[int, str]:
-        """current-vs-zombie decision for a replication-created run."""
+    def _create_mode(
+        self, domain_id: str, workflow_id: str, version: int
+    ) -> Tuple[int, str]:
+        """current-vs-zombie decision for a replication-created run
+        (shared by the event path and snapshot shipping — both create
+        runs the local cluster has never seen)."""
         try:
             cur = self.shard.persistence.execution.get_current_execution(
-                self.shard.shard_id, task.domain_id, task.workflow_id
+                self.shard.shard_id, domain_id, workflow_id
             )
         except EntityNotExistsError:
             return CreateWorkflowMode.BRAND_NEW, ""
-        if task.version >= cur.last_write_version and cur.state == int(
+        if version >= cur.last_write_version and cur.state == int(
             WorkflowState.Completed
         ):
             return CreateWorkflowMode.WORKFLOW_ID_REUSE, cur.run_id
-        if task.version > cur.last_write_version:
+        if version > cur.last_write_version:
             # incoming run was written by a NEWER failover version than
             # the still-running current run: after a failover the new
             # active cluster's run must take primacy — suppress the
@@ -512,6 +523,197 @@ class NDCHistoryReplicator:
         # signals on the stale branch still matter to the live run
         if self._is_active_locally(task.domain_id):
             self._reapply_signals(ctx, ms, task.events)
+
+    # -- snapshot shipping (bandwidth-adaptive state transfer) ---------
+
+    def _snapshot_rebuilder(self):
+        """(StateRebuilder, CheckpointManager) pair for installing
+        snapshot-shipped checkpoints. The engine-wired checkpoint plane
+        is reused when present (shipped rows land in the durable store
+        and seed future rebuilds); otherwise a transient in-memory
+        store, cached on this replicator, carries the install — the
+        optimization works either way, only its persistence differs."""
+        if self.rebuilder.checkpoints is not None:
+            return self.rebuilder, self.rebuilder.checkpoints
+        if self._transient_snapshots is None:
+            from cadence_tpu.checkpoint import (
+                CheckpointManager,
+                CheckpointPolicy,
+                MemoryCheckpointStore,
+            )
+
+            mgr = CheckpointManager(
+                MemoryCheckpointStore(),
+                CheckpointPolicy(every_events=1 << 30, keep_last=1),
+            )
+            self._transient_snapshots = (
+                StateRebuilder(
+                    self.shard.persistence.history,
+                    domain_resolver=self._resolve_domain,
+                    checkpoints=mgr,
+                    metrics=self._raw_metrics,
+                ),
+                mgr,
+            )
+        return self._transient_snapshots
+
+    def apply_state_snapshot(
+        self, domain_id: str, workflow_id: str, run_id: str, ckpt,
+    ) -> Optional[dict]:
+        """Install a snapshot-shipped ``ReplayCheckpoint`` as the run's
+        local state via the existing suffix-only resume path: the row
+        is keyed to the LOCAL branch, put into the checkpoint store,
+        and the standard rebuilder consults it — a tip hit rehydrates
+        without replaying the covered prefix (``events_replayed_saved``
+        counts it), exactly like a warm rebuild.
+
+        Returns ``{"covered_through", "backfill_from"}`` on success —
+        the caller owes a history backfill of that range (state is
+        current; the history bytes arrive behind it) — or None when the
+        snapshot cannot be applied (stale vs local state, divergent
+        local branch, stale fingerprint/caps): the caller falls back to
+        event shipping, the correctness baseline."""
+        import dataclasses as _dc
+
+        if not ckpt.vh_items or ckpt.event_id < 1:
+            return None
+        if self._fault_hook is not None:
+            self._fault_hook("apply_state_snapshot", self.shard.shard_id)
+        snap_tip = int(ckpt.event_id)
+        snap_version = int(ckpt.vh_items[-1][1])
+        ctx = self.cache.get_or_create(domain_id, workflow_id, run_id)
+        with ctx.lock:
+            try:
+                ms = ctx.load()
+            except EntityNotExistsError:
+                ms = None
+            if ms is not None:
+                local = ms.version_histories
+                if local is None:
+                    return None
+                cur_vh = local.get_current_version_history()
+                last = cur_vh.last_item()
+                if last.event_id >= snap_tip:
+                    return None  # local already at/past the snapshot
+                incoming = VersionHistory(items=[
+                    VersionHistoryItem(int(e), int(v))
+                    for e, v in ckpt.vh_items
+                ])
+                try:
+                    _, lca = local.find_lca_index_and_item(incoming)
+                except VersionHistoryError:
+                    return None
+                if lca.event_id < last.event_id:
+                    # local tip is off the snapshot's branch: that is a
+                    # version conflict the event path must resolve
+                    # (rebuild-at-LCA); fast-forwarding over it would
+                    # orphan local events
+                    return None
+                branch_token = (
+                    cur_vh.branch_token or ms.execution_info.branch_token
+                )
+                backfill_from = last.event_id + 1
+            else:
+                branch = self.shard.persistence.history.new_history_branch(
+                    tree_id=run_id
+                )
+                branch_token = branch.to_json().encode()
+                backfill_from = 1
+            if isinstance(branch_token, str):
+                branch_token = branch_token.encode()
+
+            rb, mgr = self._snapshot_rebuilder()
+            key = branch_token.decode()
+            local_ckpt = _dc.replace(
+                ckpt,
+                branch_key=key,
+                tree_id=BranchToken.from_json(key).tree_id,
+                domain_id=domain_id,
+                workflow_id=workflow_id,
+                run_id=run_id,
+            )
+            try:
+                mgr.store.put_checkpoint(local_ckpt)
+                rebuilt, transfer, timer = rb.rebuild_many([RebuildRequest(
+                    domain_id=domain_id,
+                    workflow_id=workflow_id,
+                    run_id=run_id,
+                    branch_token=branch_token,
+                    version_history_items=[
+                        (int(e), int(v)) for e, v in ckpt.vh_items
+                    ],
+                )])[0]
+            except Exception:
+                return None
+            if rebuilt is None or rebuilt.next_event_id - 1 < snap_tip:
+                # the resume didn't stick (stale fingerprint, capacity
+                # mismatch, degraded store): event shipping takes over
+                return None
+            rebuilt.execution_info.workflow_id = workflow_id
+            rebuilt.execution_info.run_id = run_id
+            rebuilt.execution_info.branch_token = branch_token
+            if rebuilt.version_histories is not None:
+                rebuilt.version_histories.get_current_version_history(
+                ).branch_token = branch_token
+
+            if ms is not None:
+                snapshot = self._snapshot(rebuilt, transfer, timer)
+                self.shard.persistence.execution.\
+                    conflict_resolve_workflow_execution(
+                        self.shard.shard_id, self.shard.range_id,
+                        ctx.condition, snapshot,
+                    )
+            else:
+                mode, prev_run_id = self._create_mode(
+                    domain_id, workflow_id, snap_version
+                )
+                snapshot = self._snapshot(
+                    rebuilt, transfer, timer,
+                    zombie=(mode == CreateWorkflowMode.ZOMBIE),
+                )
+                self.shard.persistence.execution.create_workflow_execution(
+                    self.shard.shard_id, self.shard.range_id, mode,
+                    snapshot, prev_run_id=prev_run_id,
+                )
+                if mode == CreateWorkflowMode.SUPPRESS_CURRENT:
+                    self.cache.evict(domain_id, workflow_id, prev_run_id)
+            ctx._ms = rebuilt
+            ctx._condition = rebuilt.next_event_id
+        if snapshot.transfer_tasks:
+            self._task_notifier()
+        if snapshot.timer_tasks:
+            self._timer_notifier()
+        return {
+            "covered_through": snap_tip,
+            "backfill_from": backfill_from,
+        }
+
+    def backfill_history(
+        self, domain_id: str, workflow_id: str, run_id: str, batches,
+    ) -> int:
+        """Append raw remote event batches to the run's local branch
+        WITHOUT touching workflow state — the history half of a
+        snapshot-shipped catch-up (state jumped ahead via the snapshot;
+        the covered prefix's bytes arrive behind it so the standby
+        stays byte-identical). Idempotent: a node-id collision rewrites
+        identical bytes under a fresh transaction id."""
+        batches = [b for b in batches if b]
+        if not batches:
+            return 0
+        ctx = self.cache.get_or_create(domain_id, workflow_id, run_id)
+        with ctx.lock:
+            ms = ctx.load()
+            branch = BranchToken.from_json(
+                ms.execution_info.branch_token.decode()
+            )
+            applied = 0
+            for b in batches:
+                self.shard.persistence.history.append_history_nodes(
+                    branch, list(b),
+                    transaction_id=self.shard.next_task_id(),
+                )
+                applied += len(b)
+            return applied
 
     # -- events reapplier (nDCEventsReapplier.go) ----------------------
 

@@ -9,6 +9,9 @@ back to the source on the next fetch).
 
 from __future__ import annotations
 
+import collections
+import json
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -16,6 +19,7 @@ from typing import Callable, Dict, List, Optional
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.task_processor import KeyedSequentialProcessor
 
+from ..persistence.errors import ConditionFailedError
 from ..shard import ShardContext
 from .messages import HistoryTaskV2, ReplicationMessages, RetryTaskV2Error
 from .ndc import NDCHistoryReplicator
@@ -88,6 +92,8 @@ class ReplicationTaskProcessor:
         rereplicator: Optional[HistoryRereplicator] = None,
         max_retry: int = 3,
         metrics=None,
+        transport=None,
+        backoff_max_s: float = 5.0,
     ) -> None:
         from cadence_tpu.utils.metrics import NOOP
 
@@ -100,8 +106,39 @@ class ReplicationTaskProcessor:
             service="history_replication", shard=str(shard.shard_id),
             cluster=fetcher.cluster,
         )
+        # bandwidth-adaptive transport (transport.AdaptiveTransport),
+        # shared per remote cluster like the fetcher; None = the
+        # pre-adaptive pure event-stream consumer, byte-for-byte
+        self.transport = transport
+        # a failed cycle's retry delay doubles up to this cap (jittered)
+        # and resets on the first successful cycle
+        self.backoff_max_s = backoff_max_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._backoff_rng = random.Random(shard.shard_id)
+        # deferred history backfill owed by snapshot-shipped catch-ups:
+        # (domain_id, workflow_id, run_id, from_event_id, through_id)
+        self._backfill = collections.deque()
+        # how many fetch attempts one owed range gets before it is
+        # abandoned (loudly): a source that GC'd the range can never
+        # serve it, and one poison item must not wedge the whole plane
+        self.backfill_max_attempts = 8
+        self._backfill_attempts: Dict[tuple, int] = {}
+        if self.rereplicator is not None and transport is not None:
+            # the processor owns the deferred-backfill sink; the
+            # transport is only filled in when the caller didn't wire
+            # one (never clobber an explicit choice)
+            if self.rereplicator.transport is None:
+                self.rereplicator.transport = transport
+            self.rereplicator.backfill_sink = self._enqueue_backfill
+        # durable replication progress (cursor + mode), keyed
+        # (shard, cluster); absent on pre-v5 stores → in-memory only
+        self._progress_supported = hasattr(
+            shard.persistence.shard, "get_replication_progress"
+        )
+        self._persisted_cursor = 0
+        self._persisted_debt: tuple = ()
+        self._restore_progress()
         # per-workflow-sequential, cross-workflow-parallel fallback
         # apply plane; created on first use, recreated after stop() so
         # a stop/start cycle (or a post-stop synchronous drain) works
@@ -145,7 +182,12 @@ class ReplicationTaskProcessor:
         return applied
 
     def _process_cycle(self) -> int:
+        t0 = time.monotonic()
         msgs = self.fetcher.fetch(self.shard.shard_id)
+        if self.transport is not None:
+            # the fetch IS the link probe: bytes + wall time feed the
+            # bandwidth/bytes-per-event EWMAs the mode controller reads
+            self.transport.observe_messages(msgs, time.monotonic() - t0)
         if msgs.source_time_ns:
             # the stream carries the source cluster's clock; standby
             # timer processing fires against it (ref syncShardStatus)
@@ -155,7 +197,26 @@ class ReplicationTaskProcessor:
         if not msgs.tasks:
             # nothing to apply in the range: safe to move past it
             self.fetcher.commit(self.shard.shard_id, msgs.last_retrieved_id)
-            return 0
+            self._record_lag(msgs)
+            done = self._drain_backfill()
+            self._persist_progress()
+            return done
+        applied = self._apply_cycle(msgs)
+        # page-derived lag first: a catch-up below re-gauges with the
+        # exact probe-derived residue, which must not be clobbered by
+        # this page's stale has_more proxy
+        self._record_lag(msgs)
+        if msgs.has_more and self.transport is not None \
+                and self.rereplicator is not None:
+            # deep backlog behind this page: switch to the adaptive
+            # catch-up plane instead of paying the event stream
+            # page-by-page over a link that may not afford it
+            applied += self._adaptive_catchup()
+        applied += self._drain_backfill()
+        self._persist_progress()
+        return applied
+
+    def _apply_cycle(self, msgs: ReplicationMessages) -> int:
         if len(msgs.tasks) > 1:
             try:
                 self.replicator.apply_events_batch(msgs.tasks)
@@ -172,6 +233,248 @@ class ReplicationTaskProcessor:
                     "sequential apply", shard=self.shard.shard_id,
                 )
         return self._apply_keyed(msgs.tasks)
+
+    # -- adaptive catch-up (bandwidth-adaptive state transfer) ---------
+
+    def _local_tip(self, domain_id: str, workflow_id: str,
+                   run_id: str) -> int:
+        try:
+            resp = self.shard.persistence.execution.get_workflow_execution(
+                self.shard.shard_id, domain_id, workflow_id, run_id
+            )
+            return max(0, resp.next_event_id - 1)
+        except Exception:
+            return 0
+
+    def _adaptive_catchup(self) -> int:
+        """Summary-driven backlog recovery: one tiny backlog probe
+        (per-run spans, no event payloads), then per run the mode
+        controller chooses snapshot shipping or an event heal — both
+        via the rereplicator, which owns the fallback ladder. The
+        cursor fast-forwards past the summarized span only when EVERY
+        run healed; any failure leaves it put so the next cycle retries
+        (at-least-once, both paths idempotent)."""
+        cursor = self.fetcher.last_retrieved(self.shard.shard_id)
+        summary = self.transport.fetch_backlog(self.shard.shard_id, cursor)
+        if not summary or not summary.get("runs"):
+            return 0
+        total_gap = 0
+        healed = 0
+        all_ok = True
+        for run in summary["runs"]:
+            d, wf, r = (
+                run["domain_id"], run["workflow_id"], run["run_id"],
+            )
+            local_tip = self._local_tip(d, wf, r)
+            gap = max(0, run["next_event_id"] - 1 - local_tip)
+            total_gap += gap
+            if gap == 0:
+                healed += run["tasks"]
+                continue
+            err = RetryTaskV2Error(
+                "adaptive catch-up",
+                domain_id=d, workflow_id=wf, run_id=r,
+                start_event_id=local_tip,
+                end_event_id=run["next_event_id"],
+            )
+            try:
+                self.rereplicator.rereplicate(err)
+                healed += run["tasks"]
+            except Exception:
+                all_ok = False
+                logger.exception(
+                    "adaptive catch-up failed for workflow; cursor "
+                    "held for retry",
+                    shard=self.shard.shard_id, workflow=wf, run=r,
+                )
+        # the probe knew the gap exactly; after a fully healed pass the
+        # residue is zero (the seconds view keeps its last
+        # fetch-derived estimate — the summary carries no event
+        # timestamps)
+        self.transport.record_lag(
+            0 if all_ok else total_gap,
+            self.transport.estimator.lag_seconds,
+        )
+        if all_ok:
+            # debt becomes durable BEFORE the cursor that fast-forwards
+            # past it can be acked to the source (the ack rides the
+            # NEXT fetch) — one write for the whole healed span, not
+            # one per shipped run
+            self._persist_progress()
+            self.fetcher.commit(
+                self.shard.shard_id, summary["max_task_id"]
+            )
+        return healed
+
+    def _enqueue_backfill(self, domain_id: str, workflow_id: str,
+                          run_id: str, from_event_id: int,
+                          through_event_id: int) -> None:
+        """Record the history debt a snapshot ship owes. The debt rides
+        the durable progress row next to the cursor — a restart must
+        never hold a fast-forwarded cursor without the owed ranges
+        beside it (state current, bytes gone, forever). The durable
+        write itself batches at the catch-up/cycle boundary, always
+        before the cursor can be acked to the source."""
+        item = (domain_id, workflow_id, run_id, from_event_id,
+                through_event_id)
+        if item not in self._backfill:
+            self._backfill.append(item)
+
+    def _drain_backfill(self, budget: int = 2) -> int:
+        """Fetch + append up to ``budget`` owed history ranges (the
+        byte-identity debt of snapshot shipping). A failed range
+        rotates to the BACK of the queue (later debt keeps draining)
+        and raises so the pump backs off; after
+        ``backfill_max_attempts`` failures the range is abandoned with
+        a loud log — a source that GC'd the history can never serve
+        it, and one poison range must not wedge the plane forever."""
+        if self.rereplicator is None:
+            return 0
+        done = 0
+        while self._backfill and done < budget:
+            item = self._backfill.popleft()
+            try:
+                self.rereplicator.backfill(*item)
+                self._backfill_attempts.pop(item, None)
+                done += 1
+            except Exception:
+                attempts = self._backfill_attempts.get(item, 0) + 1
+                if attempts >= self.backfill_max_attempts:
+                    self._backfill_attempts.pop(item, None)
+                    logger.exception(
+                        "history backfill range abandoned after "
+                        f"{attempts} attempts (source no longer serves "
+                        "it?); the standby is missing those bytes",
+                        shard=self.shard.shard_id, range=item,
+                    )
+                else:
+                    self._backfill_attempts[item] = attempts
+                    self._backfill.append(item)
+                raise
+        return done
+
+    # -- lag observability ---------------------------------------------
+
+    @staticmethod
+    def _lag_seconds_from(source_time_ns: int,
+                          newest_event_ts_ns: Optional[int]) -> float:
+        if not source_time_ns:
+            return 0.0
+        if not newest_event_ts_ns:
+            return 0.0
+        return max(0.0, (source_time_ns - newest_event_ts_ns) / 1e9)
+
+    def _record_lag(self, msgs: ReplicationMessages) -> None:
+        if self.transport is None:
+            return
+        newest_ts = None
+        n_events = 0
+        for t in msgs.tasks:
+            n_events += len(t.events)
+            if t.events:
+                newest_ts = t.events[-1].timestamp
+        # after a full apply the fetched span is current; only a
+        # has_more backlog leaves a known residue behind this page
+        lag_events = n_events if msgs.has_more else 0
+        self.transport.record_lag(
+            lag_events,
+            self._lag_seconds_from(msgs.source_time_ns, newest_ts),
+        )
+
+    # -- durable progress (replication_progress rows) ------------------
+
+    def _progress_blob(self, cursor: int) -> str:
+        mode = "events"
+        switches = 0
+        if self.transport is not None:
+            mode = self.transport.controller.mode
+            switches = self.transport.controller.switches
+        return json.dumps({
+            "applied_through": cursor,
+            "mode": mode,
+            "mode_switches": switches,
+            # owed history ranges from snapshot-shipped catch-ups: the
+            # byte-identity debt survives a restart with the cursor
+            "backfill": [list(item) for item in self._backfill],
+        }, sort_keys=True)
+
+    def _restore_progress(self) -> None:
+        """Resume the fetch cursor from the durable progress row — a
+        restarted standby re-fetches from where it durably applied, not
+        from task id 0."""
+        if not self._progress_supported:
+            return
+        try:
+            row = self.shard.persistence.shard.get_replication_progress(
+                self.shard.shard_id, self.fetcher.cluster
+            )
+        except Exception:
+            return
+        if not row:
+            return
+        try:
+            blob = json.loads(row[1])
+            cursor = int(blob.get("applied_through", 0))
+            debt = [tuple(item) for item in blob.get("backfill", [])]
+        except (ValueError, TypeError):
+            return
+        if cursor > 0:
+            self.fetcher.commit(self.shard.shard_id, cursor)
+            self._persisted_cursor = cursor
+        for item in debt:
+            if item not in self._backfill:
+                self._backfill.append(item)
+        self._persisted_debt = tuple(self._backfill)
+
+    def _persist_progress(self) -> None:
+        """Best-effort durable write of (cursor, mode, backfill debt)
+        under a version LWT. Torn-write semantics match
+        ``reshard_state``: a retry that reads back exactly the blob it
+        tried to write treats the torn write as landed. Writes fire on
+        cursor advance OR debt change — a drained (or newly owed)
+        backfill range must reach the row even when the cursor sat
+        still."""
+        if not self._progress_supported:
+            return
+        cursor = self.fetcher.last_retrieved(self.shard.shard_id)
+        debt = tuple(self._backfill)
+        if cursor <= self._persisted_cursor and \
+                debt == self._persisted_debt:
+            return
+        blob = self._progress_blob(cursor)
+        mgr = self.shard.persistence.shard
+        for _ in range(3):
+            try:
+                row = mgr.get_replication_progress(
+                    self.shard.shard_id, self.fetcher.cluster
+                )
+                version = row[0] if row else 0
+                mgr.set_replication_progress(
+                    self.shard.shard_id, self.fetcher.cluster, blob,
+                    version,
+                )
+                self._persisted_cursor = cursor
+                self._persisted_debt = debt
+                return
+            except Exception as e:
+                try:
+                    row = mgr.get_replication_progress(
+                        self.shard.shard_id, self.fetcher.cluster
+                    )
+                except Exception:
+                    row = None
+                if row and row[1] == blob:
+                    # the torn write landed; the lost ack is paid
+                    self._persisted_cursor = cursor
+                    self._persisted_debt = debt
+                    return
+                if not isinstance(e, ConditionFailedError):
+                    logger.warn(
+                        "replication progress write failed "
+                        f"({type(e).__name__}); cursor stays in-memory",
+                        shard=self.shard.shard_id,
+                    )
+                    return
 
     def _apply_keyed(self, tasks) -> int:
         """Per-task fallback: runs sequentially PER WORKFLOW (a
@@ -275,9 +578,17 @@ class ReplicationTaskProcessor:
             return
 
         def pump() -> None:
+            # capped jittered exponential backoff on FAILED cycles: a
+            # dead remote link costs one retry per backoff_max_s (not a
+            # log line every interval_s), and the first successful
+            # cycle resets the ladder so a healed link resumes at full
+            # pull cadence immediately
+            backoff_s = interval_s
             while not self._stop.is_set():
                 try:
-                    if self.process_once() == 0:
+                    n = self.process_once()
+                    backoff_s = interval_s
+                    if n == 0:
                         self._stop.wait(interval_s)
                 except Exception:
                     logger.exception(
@@ -285,7 +596,13 @@ class ReplicationTaskProcessor:
                         shard=self.shard.shard_id,
                         cluster=self.fetcher.cluster,
                     )
-                    self._stop.wait(interval_s)
+                    self._metrics.inc("replication_pump_backoffs")
+                    # full jitter in [backoff/2, backoff): concurrent
+                    # shards pulling one dead link don't retry in phase
+                    self._stop.wait(
+                        backoff_s * (0.5 + 0.5 * self._backoff_rng.random())
+                    )
+                    backoff_s = min(backoff_s * 2, self.backoff_max_s)
 
         self._thread = threading.Thread(target=pump, daemon=True)
         self._thread.start()

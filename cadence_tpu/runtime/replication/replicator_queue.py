@@ -32,6 +32,7 @@ class ReplicatorQueueProcessor:
         remote_clusters: Optional[List[str]] = None,
         metrics=None,
         faults=None,
+        checkpoints=None,
     ) -> None:
         from cadence_tpu.utils.metrics import NOOP
 
@@ -57,6 +58,11 @@ class ReplicatorQueueProcessor:
         )
         self._max_served = 0
         self._completed_through = 0  # highest min-ack already swept
+        # snapshot-shipping serving plane: the engine-wired checkpoint
+        # store when present (shipped rows persist and double as warm
+        # rebuild seeds), else a lazily built transient store
+        self._checkpoints = checkpoints
+        self._snapshot_server = None
 
     # -- hydration ----------------------------------------------------
 
@@ -192,6 +198,131 @@ class ReplicatorQueueProcessor:
             tasks=out, last_retrieved_id=last_id, has_more=has_more,
             source_time_ns=self.shard.now(),
         )
+
+    def get_replication_backlog(self, last_retrieved_id: int) -> dict:
+        """Per-run backlog spans past the cursor WITHOUT event payloads
+        — the adaptive consumer's cheap "how far behind am I" probe
+        (transport.py). A few hundred bytes describe a backlog whose
+        hydrated events could be megabytes, which is the whole point on
+        a constrained link."""
+        if self._fault_hook is not None:
+            self._fault_hook("get_replication_backlog", self.shard.shard_id)
+        runs: Dict[tuple, dict] = {}
+        read_from = last_retrieved_id
+        max_id = last_retrieved_id
+        while True:
+            tasks = self.shard.persistence.execution.get_replication_tasks(
+                self.shard.shard_id, read_from, self.batch_size
+            )
+            if not tasks:
+                break
+            for t in tasks:
+                max_id = max(max_id, t.task_id)
+                key = (t.domain_id, t.workflow_id, t.run_id)
+                rec = runs.get(key)
+                if rec is None:
+                    runs[key] = rec = {
+                        "domain_id": t.domain_id,
+                        "workflow_id": t.workflow_id,
+                        "run_id": t.run_id,
+                        "first_event_id": t.first_event_id,
+                        "next_event_id": t.next_event_id,
+                        "tasks": 0,
+                    }
+                rec["first_event_id"] = min(
+                    rec["first_event_id"], t.first_event_id
+                )
+                rec["next_event_id"] = max(
+                    rec["next_event_id"], t.next_event_id
+                )
+                rec["tasks"] += 1
+            read_from = tasks[-1].task_id
+        return {
+            "runs": list(runs.values()),
+            "max_task_id": max_id,
+            "source_time_ns": self.shard.now(),
+        }
+
+    # -- snapshot shipping (bandwidth-adaptive state transfer) ---------
+
+    def _snapshot_serving(self):
+        """(StateRebuilder, CheckpointManager) used to SERVE snapshot
+        requests. ``every_events=1`` so a serve-time rebuild always
+        leaves a branch-tip snapshot in the store (the wired policy's
+        cadence is a write-amplification knob for the rebuild path, not
+        a serving constraint)."""
+        if self._snapshot_server is None:
+            from cadence_tpu.checkpoint import (
+                CheckpointManager,
+                CheckpointPolicy,
+                MemoryCheckpointStore,
+            )
+
+            from .rebuilder import StateRebuilder
+
+            store = (
+                self._checkpoints.store
+                if self._checkpoints is not None
+                else MemoryCheckpointStore()
+            )
+            mgr = CheckpointManager(
+                store, CheckpointPolicy(every_events=1, keep_last=2)
+            )
+            self._snapshot_server = (
+                StateRebuilder(
+                    self.shard.persistence.history,
+                    checkpoints=mgr,
+                ),
+                mgr,
+            )
+        return self._snapshot_server
+
+    def get_replication_checkpoint(
+        self, domain_id: str, workflow_id: str, run_id: str
+    ) -> bytes:
+        """The run's branch-tip ``ReplayCheckpoint``, delta-compressed
+        for the wire (transport.encode_checkpoint_wire), or ``b""``
+        when no shippable snapshot exists (unknown run, capacity
+        overflow, device plane unavailable) — the consumer then falls
+        back to event shipping."""
+        from ..persistence.records import current_version_history
+        from .rebuilder import RebuildRequest
+        from .transport import encode_checkpoint_wire
+
+        if self._fault_hook is not None:
+            self._fault_hook(
+                "get_replication_checkpoint", self.shard.shard_id
+            )
+        try:
+            resp = self.shard.persistence.execution.get_workflow_execution(
+                self.shard.shard_id, domain_id, workflow_id, run_id
+            )
+        except EntityNotExistsError:
+            return b""
+        token, items = current_version_history(resp.snapshot)
+        if not token or not items:
+            return b""
+        tip = items[-1][0]
+        rb, mgr = self._snapshot_serving()
+        ckpt, _ = mgr.lookup(token, version_history_items=items)
+        if ckpt is None or ckpt.event_id < tip:
+            # no tip snapshot on file: rebuild once (suffix-only when an
+            # older snapshot exists) and pick up the row it wrote
+            try:
+                rb.rebuild_many([RebuildRequest(
+                    domain_id=domain_id, workflow_id=workflow_id,
+                    run_id=run_id, branch_token=token.encode(),
+                    version_history_items=items,
+                )])
+            except Exception:
+                return b""
+            ckpt, _ = mgr.lookup(token, version_history_items=items)
+            if ckpt is None or ckpt.event_id < tip:
+                return b""
+        try:
+            return encode_checkpoint_wire(ckpt)
+        except Exception:
+            return b""
 
     def ack(self, cluster: str, level: int) -> None:
         """Complete tasks every remote cluster has retrieved."""

@@ -5,47 +5,165 @@ side raises a retry error (missing earlier events), read the missing
 range [start_event_id+1, end_event_id) from the remote cluster's raw
 history API and apply it batch-by-batch through the same replicator,
 then let the caller retry the original task.
+
+Bandwidth-adaptive twist (transport.py): with an ``AdaptiveTransport``
+attached, every gap first consults the mode controller. A deep gap on a
+constrained link recovers by **snapshot shipping** — fetch the source's
+delta-compressed ``ReplayCheckpoint``, install it through the suffix-only
+resume path (``NDCHistoryReplicator.apply_state_snapshot``), and owe a
+history backfill for the covered range — instead of re-shipping and
+re-replaying the whole event backlog. Any snapshot-path failure (torn
+transfer, stale fingerprint, divergent local branch) falls back to the
+event path below, which remains the correctness baseline.
 """
 
 from __future__ import annotations
 
-from typing import List
+import time
+from typing import List, Optional
 
 from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.utils.log import get_logger
+from cadence_tpu.utils.metrics import NOOP
 
 from .messages import HistoryTaskV2, RetryTaskV2Error
+from .transport import MODE_SNAPSHOT
+
+logger = get_logger("cadence_tpu.replication")
 
 
 class HistoryRereplicator:
-    def __init__(self, remote_client, replicator) -> None:
+    def __init__(self, remote_client, replicator, transport=None,
+                 metrics=None) -> None:
         """``remote_client`` must expose get_workflow_history_raw(...)
         → (batches, version_history_items); ``replicator`` is the local
-        NDCHistoryReplicator."""
+        NDCHistoryReplicator. ``transport`` (AdaptiveTransport) enables
+        the snapshot recovery mode; None keeps pure event shipping."""
         self.remote = remote_client
         self.replicator = replicator
+        self.transport = transport
+        self._metrics = (metrics or NOOP).tagged(layer="replication")
+        # the consumer's deferred-backfill hook: when set, a snapshot
+        # recovery enqueues its history backfill there (state catches
+        # up now, bytes follow); unset, the backfill runs inline so a
+        # standalone rereplicator still converges byte-identical
+        self.backfill_sink = None
 
     def rereplicate(self, err: RetryTaskV2Error) -> int:
-        """Fetch + apply the missing range; returns batches applied."""
+        """Fetch + apply the missing range; returns batches applied
+        (0 when a snapshot recovery covered the gap instead)."""
+        gap = max(0, (err.end_event_id or 0) - (err.start_event_id or 0))
+        if (
+            self.transport is not None
+            and self.transport.controller.decide(gap) == MODE_SNAPSHOT
+        ):
+            try:
+                if self._snapshot_recover(err):
+                    return 0
+                self._metrics.inc("replication_snapshot_fallbacks")
+            except Exception:
+                # torn snapshot transfer / partitioned link mid-blob:
+                # the event path below re-fetches through the same
+                # (possibly still degraded) link and stays correct
+                self._metrics.inc("replication_snapshot_fallbacks")
+                logger.exception(
+                    "snapshot recovery failed; falling back to event "
+                    "shipping",
+                    workflow=err.workflow_id, run=err.run_id,
+                )
         start = err.start_event_id + 1 if err.start_event_id else 1
         end = err.end_event_id or (1 << 60)
-        batches, items = self.remote.get_workflow_history_raw(
-            err.domain_id, err.workflow_id, err.run_id, start, end
-        )
-        applied = 0
-        for batch in batches:
-            if not batch:
-                continue
-            task = HistoryTaskV2(
-                task_id=0,
-                domain_id=err.domain_id,
-                workflow_id=err.workflow_id,
-                run_id=err.run_id,
-                version_history_items=_items_up_to(items, batch),
-                events=list(batch),
+        if self.transport is not None:
+            batches, items = self.transport.fetch_raw_history(
+                err.domain_id, err.workflow_id, err.run_id, start, end
             )
-            self.replicator.apply_events(task)
-            applied += 1
+        else:
+            batches, items = self.remote.get_workflow_history_raw(
+                err.domain_id, err.workflow_id, err.run_id, start, end
+            )
+        return apply_raw_history(
+            self.replicator, err.domain_id, err.workflow_id, err.run_id,
+            batches, items,
+        )
+
+    # -- snapshot recovery --------------------------------------------
+
+    def _snapshot_recover(self, err: RetryTaskV2Error) -> bool:
+        got = self.transport.fetch_snapshot(
+            err.domain_id, err.workflow_id, err.run_id
+        )
+        if got is None:
+            return False
+        ckpt, nbytes = got
+        t0 = time.monotonic()
+        res = self.replicator.apply_state_snapshot(
+            err.domain_id, err.workflow_id, err.run_id, ckpt
+        )
+        if res is None:
+            return False
+        self.transport.estimator.observe_snapshot(
+            nbytes, time.monotonic() - t0
+        )
+        self._metrics.inc("replication_snapshots_shipped")
+        if self.backfill_sink is not None:
+            self.backfill_sink(
+                err.domain_id, err.workflow_id, err.run_id,
+                res["backfill_from"], res["covered_through"],
+            )
+        else:
+            self.backfill(
+                err.domain_id, err.workflow_id, err.run_id,
+                res["backfill_from"], res["covered_through"],
+            )
+        return True
+
+    def backfill(self, domain_id: str, workflow_id: str, run_id: str,
+                 from_event_id: int, through_event_id: int) -> int:
+        """Fetch + append the raw history range a snapshot covered —
+        the byte-identity half of snapshot shipping. Returns events
+        appended."""
+        if from_event_id > through_event_id:
+            return 0
+        if self.transport is not None:
+            batches, _ = self.transport.fetch_raw_history(
+                domain_id, workflow_id, run_id,
+                from_event_id, through_event_id + 1,
+            )
+        else:
+            batches, _ = self.remote.get_workflow_history_raw(
+                domain_id, workflow_id, run_id,
+                from_event_id, through_event_id + 1,
+            )
+        applied = self.replicator.backfill_history(
+            domain_id, workflow_id, run_id, batches
+        )
+        if applied:
+            self._metrics.inc("replication_backfill_events", applied)
         return applied
+
+
+def apply_raw_history(
+    replicator, domain_id: str, workflow_id: str, run_id: str,
+    batches, items: Optional[List[dict]],
+) -> int:
+    """Apply raw remote batches through the NDC replicator, one
+    synthetic HistoryTaskV2 per batch — the event-shipping heal shared
+    by the rereplicator and the adaptive catch-up cycle."""
+    applied = 0
+    for batch in batches:
+        if not batch:
+            continue
+        task = HistoryTaskV2(
+            task_id=0,
+            domain_id=domain_id,
+            workflow_id=workflow_id,
+            run_id=run_id,
+            version_history_items=_items_up_to(items or [], batch),
+            events=list(batch),
+        )
+        replicator.apply_events(task)
+        applied += 1
+    return applied
 
 
 def _items_up_to(

@@ -84,8 +84,12 @@ class HistoryService:
         # processors need clients; clients need the controller)
         self.matching_client = None
         self.history_client = None
-        # remote-cluster pull plane: cluster -> (client, fetcher);
-        # each owned shard gets a ReplicationTaskProcessor per entry
+        # config.ReplicationConfig (`replication:` section) — adaptive
+        # transport + pump backoff knobs; None = defaults (adaptive on)
+        self.replication_config = None
+        # remote-cluster pull plane: cluster -> (client, fetcher,
+        # transport); each owned shard gets a ReplicationTaskProcessor
+        # per entry, all sharing the link's transport/estimator
         self._replication_sources: Dict[str, tuple] = {}
         # remote clusters this host stands by for (standby queue planes)
         self.standby_clusters: List[str] = []
@@ -184,17 +188,27 @@ class HistoryService:
         # (reference replicationTaskProcessor per shard per remote).
         # AFTER the notifier assignment: touching engine.ndc_replicator
         # materializes it with whatever notifiers exist at that moment
-        for cluster, (client, fetcher) in self._replication_sources.items():
+        for cluster, (client, fetcher, transport) in (
+            self._replication_sources.items()
+        ):
             from .replication import (
                 HistoryRereplicator,
                 ReplicationTaskProcessor,
             )
 
-            rerepl = HistoryRereplicator(client, engine.ndc_replicator)
+            rerepl = HistoryRereplicator(
+                client, engine.ndc_replicator, transport=transport,
+                metrics=self.metrics,
+            )
+            rc = self.replication_config
             processors.append(
                 ReplicationTaskProcessor(
                     shard, engine.ndc_replicator, fetcher,
                     rereplicator=rerepl, metrics=self.metrics,
+                    transport=transport,
+                    backoff_max_s=(
+                        rc.backoff_max_s if rc is not None else 5.0
+                    ),
                 )
             )
         for p in processors:
@@ -206,11 +220,32 @@ class HistoryService:
         adapter or rpc.RemoteClusterRPCClient) BEFORE start(): every
         owned shard then runs a ReplicationTaskProcessor draining that
         cluster's replicator queue (reference replicationTaskFetcher +
-        replicationTaskProcessor assembly, service/history/service.go)."""
-        from .replication import ReplicationTaskFetcher
+        replicationTaskProcessor assembly, service/history/service.go).
 
+        The link also gets one AdaptiveTransport (estimator + mode
+        controller, shared across the shards' processors the way the
+        fetcher is) unless the `replication:` config disables it."""
+        from .replication import ReplicationTaskFetcher
+        from .replication.transport import AdaptiveTransport
+
+        rc = self.replication_config
+        transport = None
+        if rc is None or rc.adaptive:
+            transport = AdaptiveTransport(
+                client, cluster,
+                hysteresis=rc.hysteresis if rc is not None else 1.5,
+                min_dwell=rc.min_dwell if rc is not None else 2,
+                min_gap_events=(
+                    rc.min_gap_events if rc is not None else 32
+                ),
+                snapshot_bytes_prior=(
+                    rc.snapshot_bytes_prior
+                    if rc is not None else 64 * 1024.0
+                ),
+                metrics=self.metrics,
+            )
         self._replication_sources[cluster] = (
-            client, ReplicationTaskFetcher(cluster, client)
+            client, ReplicationTaskFetcher(cluster, client), transport
         )
 
     def _on_domain_failover(
@@ -304,4 +339,18 @@ class HistoryService:
         engine = self.controller.get_engine(workflow_id)
         return engine.get_workflow_history_raw(
             domain_id, workflow_id, run_id, start_event_id, end_event_id
+        )
+
+    def get_replication_backlog(
+        self, shard_id: int, last_retrieved_id: int
+    ):
+        engine = self.controller.get_engine_for_shard(shard_id)
+        return engine.get_replication_backlog(last_retrieved_id)
+
+    def get_replication_checkpoint(
+        self, domain_id: str, workflow_id: str, run_id: str
+    ) -> bytes:
+        engine = self.controller.get_engine(workflow_id)
+        return engine.get_replication_checkpoint(
+            domain_id, workflow_id, run_id
         )

@@ -77,9 +77,28 @@ QUEUE_METRICS = (
     "task_requests", "task_latency", "task_errors", "task_outstanding",
     "task_held",
 )
+# Adaptive geo-replication (runtime/replication/transport.py) extends
+# the consumer side: replication_lag_events / replication_lag_seconds
+# gauge how far the standby's APPLIED STATE trails the source (events
+# known outstanding on the link; seconds between the source clock and
+# the newest applied event), replication_mode gauges the controller's
+# link-wide mode (0 = event shipping, 1 = snapshot shipping) with
+# replication_mode_switches counting transitions (hysteresis-damped),
+# replication_bytes_shipped (tagged mode=) accounts every transfer,
+# replication_snapshots_shipped / replication_snapshot_fallbacks count
+# snapshot catch-ups and their event-path fallbacks (torn transfer,
+# stale fingerprint, divergent branch), replication_backfill_events
+# counts the deferred history bytes a snapshot owed, and
+# replication_pump_backoffs counts failed pump cycles entering the
+# capped jittered exponential backoff.
 REPLICATION_METRICS = (
     "replication_ack_lag", "replication_tasks_applied",
     "replication_apply_latency",
+    "replication_lag_events", "replication_lag_seconds",
+    "replication_mode", "replication_mode_switches",
+    "replication_bytes_shipped",
+    "replication_snapshots_shipped", "replication_snapshot_fallbacks",
+    "replication_backfill_events", "replication_pump_backoffs",
 )
 # chaos/fault-injection plane (testing/faults.py): every injected fault
 # increments faults_injected under tags (layer=fault_injection,

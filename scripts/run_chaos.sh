@@ -11,6 +11,11 @@
 #       # reshard-only sweep: split/merge under write faults, host
 #       # kill mid-handoff, rollback on a failed plan — every seed
 #       # re-proves byte-identical replay across the reconfiguration
+#   CHAOS_LINK=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # link-chaos sweep: constrained-bandwidth + write-fault storm
+#       # convergence, partition-window recovery, torn snapshot
+#       # transfer falling back to event shipping — every seed
+#       # re-proves the standby byte-identical to the healthy-link run
 #
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
@@ -21,6 +26,9 @@ export JAX_PLATFORMS=cpu
 FILTER=()
 if [[ -n "${CHAOS_RESHARD:-}" ]]; then
     FILTER=(-k TestReshardChaos)
+fi
+if [[ -n "${CHAOS_LINK:-}" ]]; then
+    FILTER=(-k TestLinkChaos)
 fi
 
 run_one() {

@@ -9,6 +9,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
@@ -99,6 +101,25 @@ def test_smoke_emits_one_json_record():
     assert live["handoff"]["moved_workflows"] > 0
     for key in ("samples", "p50_ms", "p99_ms", "max_ms"):
         assert key in live["during_handoff"], live["during_handoff"]
+    # the adaptive geo-replication contract: all three transport arms
+    # converge byte-identical over the throttled link, the snapshot
+    # arms prove suffix-only installs via events_replayed_saved, the
+    # adaptive controller demonstrably switches modes, and adaptive
+    # catch-up never loses to pure event shipping (the sleeps of the
+    # simulated link dominate host-load noise, so the ratio holds even
+    # at smoke scale — margin for the scheduler)
+    lag = out["configs"]["replication_lag"]
+    for arm in ("events", "snapshot", "adaptive"):
+        rec = lag[arm]
+        for key in ("catch_up_s", "converged_s", "bytes_shipped",
+                    "backlog_events", "converged"):
+            assert key in rec, f"replication_lag.{arm} lacks {key}"
+        assert rec["converged"] is True, (arm, rec)
+    assert lag["snapshot"]["snapshots_shipped"] > 0, lag["snapshot"]
+    assert lag["snapshot"]["events_replayed_saved"] > 0, lag["snapshot"]
+    assert lag["adaptive"]["mode_switches"] >= 1, lag["adaptive"]
+    assert lag["adaptive"]["catch_up_s"] <= \
+        lag["events"]["catch_up_s"] * 1.25, lag
 
 
 def test_watchdog_still_yields_parseable_record():
@@ -108,3 +129,35 @@ def test_watchdog_still_yields_parseable_record():
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in out, out
     assert "error" in out
+
+
+def test_failing_probe_degrades_to_flagged_cpu_record():
+    """BENCH_r04 regression: a dead accelerator probe must yield a
+    full, flagged CPU-fallback record (rc 0, backend_note set) — never
+    an rc=1 crash or an error-only record. BENCH_BUDGET_S=0 trims to
+    the headline config so the pin stays cheap."""
+    out = _run({"BENCH_SMOKE": "1", "BENCH_SIM_PROBE_FAIL": "1",
+                "BENCH_BUDGET_S": "0"})
+    assert out["backend"]["platform"] == "cpu"
+    assert out["backend"]["probe"] == "failed-or-timeout"
+    assert out["backend"]["fallback"] is True
+    assert "backend_note" in out and "CPU fallback" in out["backend_note"]
+    assert "error" not in out, out
+    assert out["configs"]["retry_deep"]["histories_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_backend_init_failure_midrun_degrades_not_crashes():
+    """The probe succeeds but the in-process plugin init throws (the
+    exact BENCH_r04 shape): the run must degrade to the CPU-fallback
+    record with backend_note, still rc 0 with a real headline.
+    slow-marked: a full extra bench invocation; the sibling
+    failing-probe pin covers the same degrade ladder in tier-1."""
+    out = _run({"BENCH_SMOKE": "1", "BENCH_SIM_BACKEND_INIT_FAIL": "1",
+                "BENCH_BUDGET_S": "0"})
+    assert out["backend"]["platform"] == "cpu"
+    assert out["backend"]["fallback"] is True
+    assert "backend_note" in out
+    assert "backend init failed" in out["backend_note"]
+    assert "error" not in out, out
+    assert out["configs"]["retry_deep"]["histories_per_sec"] > 0

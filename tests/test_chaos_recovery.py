@@ -807,6 +807,438 @@ def _drive_concurrent(box, workflow_ids, mid=None, timeout_s=60.0):
         w.stop()
 
 
+# ---------------------------------------------------------------------------
+# geographic link chaos (bandwidth-adaptive replication transport)
+# ---------------------------------------------------------------------------
+
+
+class _GeoAdapter:
+    """RemoteClusterClient over the in-process active cluster."""
+
+    def __init__(self, svc):
+        self.svc = svc
+
+    def get_replication_messages(self, shard_id, last_retrieved_id):
+        return self.svc.get_replication_messages(
+            shard_id, last_retrieved_id, cluster="standby"
+        )
+
+    def get_workflow_history_raw(self, *a):
+        return self.svc.get_workflow_history_raw(*a)
+
+    def get_replication_backlog(self, shard_id, last_retrieved_id):
+        return self.svc.get_replication_backlog(
+            shard_id, last_retrieved_id
+        )
+
+    def get_replication_checkpoint(self, *a):
+        return self.svc.get_replication_checkpoint(*a)
+
+
+class GeoChaosBox:
+    """Two deterministic in-process clusters: the ACTIVE side drives
+    the doubler workload under the ChaosBox discipline (frozen clock,
+    pinned poll nonce, optional write-fault storm); the STANDBY pulls
+    the replication stream through an optionally degraded
+    ``SimulatedLink`` with the bandwidth-adaptive transport attached.
+    Replication is drained explicitly (``drain_replication``) so tests
+    control exactly when the link starts carrying the backlog."""
+
+    GEO_DOMAIN_ID = "geo-dom-0000"
+
+    def __init__(self, faults=None, link_profile=None, adaptive=True,
+                 force_mode=None, min_gap_events=4,
+                 snapshot_bytes_prior=4096.0, client_wrap=None,
+                 backoff_max_s=0.2):
+        from cadence_tpu.cluster import (
+            ClusterInformation,
+            ClusterMetadata,
+        )
+        from cadence_tpu.runtime.domains import register_domain
+        from cadence_tpu.runtime.replication import (
+            AdaptiveTransport,
+            HistoryRereplicator,
+            ReplicationTaskFetcher,
+            ReplicationTaskProcessor,
+        )
+        from cadence_tpu.testing.faults import chaos_link
+
+        self.clock = FakeTimeSource()
+        self.metrics = Scope()          # active-side registry
+        self.standby_metrics = Scope()  # standby-side registry
+
+        def meta(name):
+            return ClusterMetadata(
+                failover_version_increment=10,
+                master_cluster_name="active",
+                current_cluster_name=name,
+                cluster_info={
+                    "active": ClusterInformation(
+                        initial_failover_version=1),
+                    "standby": ClusterInformation(
+                        initial_failover_version=2),
+                },
+            )
+
+        def cluster(name, cluster_faults, scope):
+            persistence = create_memory_bundle()
+            if cluster_faults is not None:
+                persistence = wrap_bundle(
+                    persistence, metrics=scope, faults=cluster_faults
+                )
+            register_domain(
+                persistence.metadata, DOMAIN, is_global=True,
+                clusters=["active", "standby"],
+                active_cluster="active",
+                domain_id=self.GEO_DOMAIN_ID, failover_version=1,
+            )
+            domains = DomainCache(persistence.metadata)
+            svc = HistoryService(
+                1, persistence, domains,
+                single_host_monitor(f"geo-{name}"),
+                time_source=self.clock, metrics=scope,
+                faults=cluster_faults, cluster_metadata=meta(name),
+            )
+            hc = HistoryClient(svc.controller)
+            matching = MatchingEngine(
+                persistence.task, hc,
+                poll_request_id_fn=(
+                    lambda info: f"rid-{info.workflow_id}-"
+                    f"{info.schedule_id}"
+                ),
+            )
+            svc.wire(MatchingClient(matching), hc)
+            svc.start()
+            return {
+                "svc": svc, "hc": hc, "matching": matching,
+                "persistence": persistence, "domains": domains,
+            }
+
+        self.active = cluster("active", faults, self.metrics)
+        self.standby = cluster("standby", None, self.standby_metrics)
+        self.frontend = WorkflowHandler(
+            DomainHandler(
+                self.active["persistence"].metadata, ClusterMetadata()
+            ),
+            self.active["domains"], self.active["hc"],
+            MatchingClient(self.active["matching"]),
+        )
+        # small emit pages: the first fetch is the link probe, not the
+        # whole hydrated backlog in one transfer
+        self.active["svc"].controller.get_engine_for_shard(
+            0).replicator_queue.batch_size = 4
+
+        base = _GeoAdapter(self.active["svc"])
+        self.link = None
+        client = base
+        if link_profile is not None:
+            client = chaos_link(base, link_profile, seed=CHAOS_SEED)
+            self.link = client.link
+        if client_wrap is not None:
+            client = client_wrap(client)
+        self.client = client
+        self.fetcher = ReplicationTaskFetcher("active", client)
+        self.transport = None
+        if adaptive:
+            self.transport = AdaptiveTransport(
+                client, "active", min_gap_events=min_gap_events,
+                min_dwell=1,
+                snapshot_bytes_prior=snapshot_bytes_prior,
+                force_mode=force_mode, metrics=self.standby_metrics,
+            )
+        engine = self.standby["svc"].controller.get_engine_for_shard(0)
+        self.standby_engine = engine
+        rerepl = HistoryRereplicator(
+            client, engine.ndc_replicator, transport=self.transport,
+            metrics=self.standby_metrics,
+        )
+        self.processor = ReplicationTaskProcessor(
+            engine.shard, engine.ndc_replicator, self.fetcher,
+            rereplicator=rerepl, metrics=self.standby_metrics,
+            transport=self.transport, backoff_max_s=backoff_max_s,
+        )
+
+    def drain_replication(self, timeout_s=60.0,
+                          swallow=()) -> int:
+        """process_once until quiescent; exceptions in ``swallow`` are
+        retried (partition windows heal by transfer index)."""
+        total = 0
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                n = self.processor.process_once()
+            except swallow:
+                continue
+            total += n
+            if n == 0:
+                return total
+        raise AssertionError("replication never drained")
+
+    def active_history(self, wid, rid):
+        engine = self.active["svc"].controller.get_engine(wid)
+        events, _ = engine.get_workflow_execution_history(
+            DOMAIN, wid, rid
+        )
+        return json.dumps(
+            [e.to_dict() for e in events], sort_keys=True, default=repr
+        )
+
+    def standby_history(self, wid, rid):
+        events, _ = self.standby_engine.get_workflow_execution_history(
+            DOMAIN, wid, rid
+        )
+        return json.dumps(
+            [e.to_dict() for e in events], sort_keys=True, default=repr
+        )
+
+    def stop(self):
+        self.active["svc"].stop()
+        self.active["matching"].shutdown()
+        self.standby["svc"].stop()
+        self.standby["matching"].shutdown()
+
+
+_GEO_WIDS = [f"geo-wf-{i}" for i in range(2)]
+_GEO_STORM_WIDS = [f"geo-sig-{i}" for i in range(2)]
+_GEO_SIGNALS = 18
+_GEO_CLEAN: dict = {}  # wid -> standby history, healthy-link baseline
+
+
+def _drive_geo(box):
+    """Drive the deterministic geo workload on the ACTIVE cluster
+    (standby not pulling yet — the backlog accumulates): the doubler
+    trio to completion under the worker, then a signal-deepened open
+    cohort on a pollerless task list — deep histories whose event
+    backlog dwarfs a compressed state snapshot, the shape snapshot
+    shipping exists for. Returns {wid: run_id}."""
+    from cadence_tpu.runtime.api import SignalRequest
+
+    w = Worker(box.frontend, DOMAIN, TL, identity="chaos-worker",
+               sticky=False)
+    w.register_workflow("chaos-wf", _chained_doubler)
+    w.register_activity("double", lambda inp: inp * 2)
+    w.start()
+    runs = {}
+    try:
+        for wid in _GEO_WIDS:
+            runs[wid] = box.frontend.start_workflow_execution(
+                StartWorkflowRequest(
+                    domain=DOMAIN, workflow_id=wid,
+                    workflow_type="chaos-wf", task_list=TL, input=b"x",
+                    request_id=f"req-{wid}",
+                    execution_start_to_close_timeout_seconds=60,
+                )
+            )
+        deadline = time.monotonic() + 30.0
+        for wid in _GEO_WIDS:
+            while time.monotonic() < deadline:
+                d = box.frontend.describe_workflow_execution(
+                    DOMAIN, wid, runs[wid]
+                )
+                if not d.is_running:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"workflow {wid} did not complete")
+    finally:
+        w.stop()
+    for wid in _GEO_STORM_WIDS:
+        runs[wid] = box.frontend.start_workflow_execution(
+            StartWorkflowRequest(
+                domain=DOMAIN, workflow_id=wid,
+                workflow_type="chaos-wf", task_list="geo-sig-tl",
+                input=b"x", request_id=f"req-{wid}",
+                execution_start_to_close_timeout_seconds=300,
+            )
+        )
+        for k in range(_GEO_SIGNALS):
+            box.frontend.signal_workflow_execution(SignalRequest(
+                domain=DOMAIN, workflow_id=wid, signal_name=f"s{k}",
+                input=b"x" * 96, identity="geo",
+            ))
+    return runs
+
+
+def _geo_clean_baseline():
+    """Healthy-link, fault-free run — the static baseline every link
+    chaos scenario must converge byte-identically to."""
+    if not _GEO_CLEAN:
+        box = GeoChaosBox()
+        try:
+            runs = _drive_geo(box)
+            box.drain_replication()
+            for wid, rid in runs.items():
+                standby = box.standby_history(wid, rid)
+                assert standby == box.active_history(wid, rid)
+                _GEO_CLEAN[wid] = standby
+        finally:
+            box.stop()
+    return dict(_GEO_CLEAN)
+
+
+class TestLinkChaos:
+    """The degraded-WAN scenario family: a standby cluster behind a
+    constrained/lossy link must stay live (adaptive snapshot shipping)
+    and converge byte-identical to the healthy-link run once the
+    workload quiesces — the geographic-SMR state-transfer adaptation's
+    validation suite."""
+
+    def test_constrained_link_write_storm_converges_byte_identical(self):
+        """A seeded write-fault storm on the active side plus a link
+        throttled well below the backlog's event-stream cost: the
+        adaptive controller must demonstrably switch to snapshot
+        shipping (mode-switch metric > 0), installs must ride the
+        suffix-only resume path (events_replayed_saved > 0), and after
+        the storm the standby histories must be byte-identical to the
+        healthy-link baseline."""
+        from cadence_tpu.testing.faults import LinkProfile
+
+        clean = _geo_clean_baseline()
+
+        sched = _write_fault_schedule(CHAOS_SEED)
+        box = GeoChaosBox(
+            faults=sched,
+            link_profile=LinkProfile(
+                bytes_per_s=16384.0, latency_s=0.002,
+                jitter_s=0.002, max_sleep_s=0.5,
+            ),
+        )
+        try:
+            runs = _drive_geo(box)
+            assert sched.injected_total() >= 5, sched.snapshot()
+            box.drain_replication()
+            for wid, rid in runs.items():
+                got = box.standby_history(wid, rid)
+                assert got == box.active_history(wid, rid), (
+                    f"standby diverged from active for {wid}"
+                )
+                assert got == clean[wid], (
+                    f"standby history for {wid} diverged from the "
+                    "healthy-link run"
+                )
+            reg = box.standby_metrics.registry
+            assert box.transport.controller.switches >= 1, (
+                "the adaptive controller never switched modes"
+            )
+            assert reg.counter_value("replication_mode_switches") >= 1
+            assert reg.counter_value(
+                "replication_snapshots_shipped") >= 1
+            assert reg.counter_value("events_replayed_saved") > 0, (
+                "snapshot installs must ride the suffix-only resume "
+                "path"
+            )
+            assert box.link.bytes_total > 0
+        finally:
+            box.stop()
+
+    @pytest.mark.slow
+    def test_partition_window_recovers_and_pump_backs_off(self):
+        """Transfers inside the partition window raise; the pump's
+        capped jittered exponential backoff spaces the retries, and
+        once the window passes (transfer-indexed, deterministic) the
+        standby converges byte-identical.
+
+        slow-marked (still chaos-marked: every run_chaos.sh sweep runs
+        it with --runslow): the backoff ladder + second cluster pair
+        are wall-clock-hungry and tier-1's budget is shared; the
+        ladder's unit contract stays tier-1 via
+        tests/test_replication_transport.py::TestPumpBackoff."""
+        from cadence_tpu.testing.faults import LinkProfile
+
+        clean = _geo_clean_baseline()
+
+        box = GeoChaosBox(
+            link_profile=LinkProfile(partitions=((2, 10),)),
+            adaptive=False, backoff_max_s=0.1,
+        )
+        try:
+            runs = _drive_geo(box)
+            # background pump so the backoff ladder (not the test
+            # loop) owns the retries
+            box.processor.start(interval_s=0.01)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                done = True
+                for wid, rid in runs.items():
+                    try:
+                        if box.standby_history(wid, rid) != clean[wid]:
+                            done = False
+                            break
+                    except Exception:
+                        done = False
+                        break
+                if done:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "standby never converged after the partition"
+                )
+            assert box.link.partitioned_calls >= 1
+            assert box.standby_metrics.registry.counter_value(
+                "replication_pump_backoffs") >= 1, (
+                "partitioned fetches must enter the backoff ladder"
+            )
+        finally:
+            box.processor.stop()
+            box.stop()
+
+    @pytest.mark.slow
+    def test_torn_snapshot_transfer_falls_back_to_event_shipping(self):
+        """The link dies mid-snapshot-blob (every checkpoint transfer
+        truncates): the snapshot path must fall back to event shipping
+        (fallback metric counts it) and the standby still converges
+        byte-identical — degraded optimization, never degraded
+        correctness.
+
+        slow-marked for tier-1 wall clock (chaos sweeps run it); the
+        decode-side torn-blob rejection stays tier-1 via
+        TestCheckpointWireCodec."""
+        clean = _geo_clean_baseline()
+
+        class _TornSnapshots:
+            def __init__(self, base):
+                self._base = base
+                self.torn = 0
+
+            def get_replication_checkpoint(self, *a):
+                blob = self._base.get_replication_checkpoint(*a)
+                if blob:
+                    self.torn += 1
+                return blob[: len(blob) // 2]
+
+            def __getattr__(self, name):
+                return getattr(self._base, name)
+
+        wrapper = {}
+
+        def wrap(client):
+            wrapper["w"] = _TornSnapshots(client)
+            return wrapper["w"]
+
+        box = GeoChaosBox(
+            force_mode="snapshot", client_wrap=wrap,
+        )
+        try:
+            runs = _drive_geo(box)
+            box.drain_replication()
+            assert wrapper["w"].torn >= 1, (
+                "the snapshot path was never even attempted"
+            )
+            reg = box.standby_metrics.registry
+            assert reg.counter_value(
+                "replication_snapshot_fallbacks") >= 1
+            assert reg.counter_value(
+                "replication_snapshots_shipped") == 0
+            for wid, rid in runs.items():
+                assert box.standby_history(wid, rid) == clean[wid], (
+                    f"standby history for {wid} diverged after torn "
+                    "snapshot fallback"
+                )
+        finally:
+            box.stop()
+
+
 _RESHARD_WIDS = [f"rs-wf-{i}" for i in range(5)]
 _RESHARD_CLEAN: list = []  # per-process memo: identical workload/driver
 

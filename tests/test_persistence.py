@@ -519,6 +519,71 @@ class TestReshardState:
         assert bundle.shard.get_reshard_state() == (2, "b")
 
 
+class TestReplicationProgress:
+    """Consumer-side replication cursor/mode rows (adaptive
+    geo-replication) — versioned LWT semantics identical on every
+    backend, keyed (shard, remote cluster)."""
+
+    def test_absent_reads_none_and_writes_from_version_zero(self, bundle):
+        assert bundle.shard.get_replication_progress(1, "active") is None
+        bundle.shard.set_replication_progress(
+            1, "active", '{"applied_through": 7}', previous_version=0
+        )
+        assert bundle.shard.get_replication_progress(1, "active") == (
+            1, '{"applied_through": 7}'
+        )
+
+    def test_version_lwt_rejects_stale_writer(self, bundle):
+        bundle.shard.set_replication_progress(1, "active", "a", 0)
+        with pytest.raises(ConditionFailedError):
+            bundle.shard.set_replication_progress(1, "active", "b", 0)
+        bundle.shard.set_replication_progress(1, "active", "b", 1)
+        assert bundle.shard.get_replication_progress(1, "active") == (
+            2, "b"
+        )
+
+    def test_rows_keyed_per_shard_and_cluster(self, bundle):
+        bundle.shard.set_replication_progress(1, "active", "s1a", 0)
+        bundle.shard.set_replication_progress(2, "active", "s2a", 0)
+        bundle.shard.set_replication_progress(1, "other", "s1o", 0)
+        assert bundle.shard.get_replication_progress(1, "active") == (
+            1, "s1a"
+        )
+        assert bundle.shard.get_replication_progress(2, "active") == (
+            1, "s2a"
+        )
+        assert bundle.shard.get_replication_progress(1, "other") == (
+            1, "s1o"
+        )
+
+    def test_torn_write_retry_reads_landed_blob_as_success(self, bundle):
+        """The reshard_state discipline: a torn write LANDS while the
+        ack is lost; the caller's retry re-reads, sees exactly the blob
+        it meant to write at the bumped version, and treats the write
+        as durable (processor._persist_progress)."""
+        from cadence_tpu.testing.faults import FaultRule, FaultSchedule
+        from cadence_tpu.runtime.persistence.decorators import wrap_bundle
+
+        sched = FaultSchedule(seed=7, rules=[
+            FaultRule(site="persistence.shard",
+                      method="set_replication_progress",
+                      probability=1.0, max_faults=1,
+                      action="torn_write", error="TimeoutError"),
+        ])
+        wrapped = wrap_bundle(bundle, faults=sched)
+        blob = '{"applied_through": 42, "mode": "snapshot"}'
+        with pytest.raises(TimeoutError):
+            wrapped.shard.set_replication_progress(1, "active", blob, 0)
+        # the write landed; a blind retry with the stale version fences
+        with pytest.raises(ConditionFailedError):
+            wrapped.shard.set_replication_progress(1, "active", blob, 0)
+        # ... and the re-read shows the landed blob — retry succeeds by
+        # recognizing its own write, never double-bumping the version
+        assert wrapped.shard.get_replication_progress(1, "active") == (
+            1, blob
+        )
+
+
 class TestReshardMove:
     """reshard_extract / reshard_install: the handoff's row mover —
     atomic, watermark-aware, and exactly-once on task identity."""

@@ -1,0 +1,442 @@
+"""Unit coverage for the bandwidth-adaptive replication transport
+(runtime/replication/transport.py) and its chaos-layer link model
+(testing/faults.py LinkProfile/SimulatedLink): wire codec round-trip
+through the native delta codec, seeded link determinism, estimator
+EWMAs, mode-controller hysteresis (no flapping), the pump's capped
+jittered backoff, and the durable replication-progress restore path.
+The end-to-end convergence proofs live in tests/test_chaos_recovery.py
+TestLinkChaos; this file pins the pieces in isolation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.replication import (
+    MODE_EVENTS,
+    MODE_SNAPSHOT,
+    LinkEstimator,
+    ReplicationMessages,
+    ReplicationModeController,
+    ReplicationTaskFetcher,
+    ReplicationTaskProcessor,
+)
+from cadence_tpu.runtime.replication.transport import (
+    decode_checkpoint_wire,
+    encode_checkpoint_wire,
+    wire_size,
+)
+from cadence_tpu.testing.faults import (
+    LinkPartitionedError,
+    LinkProfile,
+    SimulatedLink,
+)
+from cadence_tpu.utils.metrics import Scope
+
+
+# ---------------------------------------------------------------------------
+# checkpoint wire codec
+# ---------------------------------------------------------------------------
+
+
+_CKPT_MEMO = []
+
+
+def _stored_checkpoint():
+    """A real ReplayCheckpoint via the standard rebuild+record path
+    (memoized: the rebuild compiles a kernel; one per process)."""
+    from cadence_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+    from cadence_tpu.runtime.replication.rebuilder import (
+        RebuildRequest,
+        StateRebuilder,
+    )
+    from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+    if _CKPT_MEMO:
+        return _CKPT_MEMO[0]
+    bundle = create_memory_bundle()
+    fz = HistoryFuzzer(seed=11)
+    branch = bundle.history.new_history_branch(tree_id="wire-run")
+    txn = 1
+    for b in fz.generate(target_events=40):
+        bundle.history.append_history_nodes(branch, b, transaction_id=txn)
+        txn += 1
+    mgr = CheckpointManager(
+        bundle.checkpoint, CheckpointPolicy(every_events=1)
+    )
+    StateRebuilder(bundle.history, checkpoints=mgr).rebuild_many([
+        RebuildRequest(
+            domain_id="dom", workflow_id="wire-wf", run_id="wire-run",
+            branch_token=branch.to_json().encode(),
+        )
+    ])
+    ckpts = bundle.checkpoint.list_checkpoints(branch.to_json())
+    assert ckpts, "seed rebuild wrote no checkpoint"
+    _CKPT_MEMO.append(ckpts[0])
+    return ckpts[0]
+
+
+class TestCheckpointWireCodec:
+    def test_roundtrip_bit_identical(self):
+        ckpt = _stored_checkpoint()
+        blob = encode_checkpoint_wire(ckpt)
+        back = decode_checkpoint_wire(blob)
+        assert back.to_json() == ckpt.to_json()
+
+    def test_wire_is_smaller_than_plain_json(self):
+        """The point of riding the varint+zigzag delta codec: the
+        state-row tensors dominate the record and compress well."""
+        ckpt = _stored_checkpoint()
+        assert len(encode_checkpoint_wire(ckpt)) < len(ckpt.to_json())
+
+    def test_torn_blob_raises_never_half_applies(self):
+        ckpt = _stored_checkpoint()
+        blob = encode_checkpoint_wire(ckpt)
+        with pytest.raises(ValueError):
+            decode_checkpoint_wire(blob[: len(blob) // 2])
+
+    def test_unknown_version_rejected(self):
+        with pytest.raises(ValueError):
+            decode_checkpoint_wire(b'{"v": 99, "meta": {}, "rows": {}}')
+
+    def test_wire_size_counts_bytes_and_messages(self):
+        assert wire_size(b"12345") == 5
+        assert wire_size(None) == 0
+        msgs = ReplicationMessages(tasks=[], last_retrieved_id=3)
+        assert wire_size(msgs) > 0
+
+
+# ---------------------------------------------------------------------------
+# simulated link (chaos layer)
+# ---------------------------------------------------------------------------
+
+
+class TestSimulatedLink:
+    def test_same_seed_same_delays_and_partitions(self):
+        profile = LinkProfile(
+            bytes_per_s=1e6, latency_s=0.0, jitter_s=0.002,
+            partitions=((2, 4),),
+        )
+
+        def run(seed):
+            link = SimulatedLink(profile, seed=seed)
+            out = []
+            for i in range(6):
+                try:
+                    out.append(round(link.transfer(1000), 6))
+                except LinkPartitionedError:
+                    out.append("partitioned")
+            return out
+
+        a, b = run(5), run(5)
+        assert a == b
+        assert a[2] == a[3] == "partitioned"
+        assert all(isinstance(v, float) for i, v in enumerate(a)
+                   if i not in (2, 3))
+        assert run(6) != a  # a different seed draws different jitter
+
+    def test_bandwidth_budget_sleeps(self):
+        link = SimulatedLink(LinkProfile(bytes_per_s=100_000.0))
+        t0 = time.monotonic()
+        delay = link.transfer(10_000)   # 0.1s budget
+        assert time.monotonic() - t0 >= 0.09
+        assert 0.09 <= delay <= 0.2
+        assert link.bytes_total == 10_000
+
+    def test_max_sleep_caps_the_budget(self):
+        link = SimulatedLink(
+            LinkProfile(bytes_per_s=1.0, max_sleep_s=0.05)
+        )
+        assert link.transfer(10_000) <= 0.05
+
+    def test_partitioned_transfer_ships_nothing(self):
+        link = SimulatedLink(LinkProfile(partitions=((0, 1),)))
+        with pytest.raises(LinkPartitionedError):
+            link.transfer(500)
+        assert link.bytes_total == 0
+        assert link.partitioned_calls == 1
+        link.transfer(500)  # index 1: healed
+        assert link.bytes_total == 500
+
+    def test_profile_validation(self):
+        with pytest.raises(ValueError):
+            LinkProfile(bytes_per_s=-1).validate()
+        with pytest.raises(ValueError):
+            LinkProfile(partitions=((5, 3),)).validate()
+
+
+# ---------------------------------------------------------------------------
+# estimator + mode controller
+# ---------------------------------------------------------------------------
+
+
+class TestLinkEstimator:
+    def test_ewma_converges_on_observations(self):
+        est = LinkEstimator(alpha=0.5)
+        assert est.bandwidth_bps() is None
+        est.observe_transfer(100_000, 1.0, n_events=100)
+        assert est.bandwidth_bps() == pytest.approx(100_000)
+        assert est.bytes_per_event() == pytest.approx(1000)
+        est.observe_transfer(300_000, 1.0, n_events=100)
+        assert est.bandwidth_bps() == pytest.approx(200_000)
+        est.observe_snapshot(10_000, 0.02)
+        assert est.snapshot_bytes() == pytest.approx(10_000)
+        assert est.snapshot_apply_s() == pytest.approx(0.02)
+
+    def test_zero_duration_and_empty_transfers_ignored(self):
+        est = LinkEstimator()
+        est.observe_transfer(0, 1.0)
+        est.observe_transfer(100, 0.0)
+        assert est.bandwidth_bps() is None
+
+
+class TestModeController:
+    def _est(self, bw=100_000.0, bpe=1000.0, snap=10_000.0,
+             apply_s=0.01):
+        est = LinkEstimator(alpha=1.0)
+        est.observe_transfer(int(bw), 1.0, n_events=int(bw // bpe))
+        est.observe_snapshot(int(snap), apply_s)
+        return est
+
+    def test_unknown_bandwidth_always_events(self):
+        ctrl = ReplicationModeController(LinkEstimator())
+        for _ in range(5):
+            assert ctrl.decide(10_000) == MODE_EVENTS
+        assert ctrl.switches == 0
+
+    def test_min_dwell_blocks_single_sample_switch(self):
+        ctrl = ReplicationModeController(
+            self._est(), hysteresis=1.5, min_dwell=2, min_gap_events=10
+        )
+        # gap 100: t_events = 1.0s vs t_snap = 0.11s — snapshot wants
+        # the switch, but one decision is not enough (dwell damping)
+        assert ctrl.decide(100) == MODE_EVENTS
+        assert ctrl.decide(100) == MODE_SNAPSHOT
+        assert ctrl.switches == 1
+
+    def test_small_gaps_never_snapshot(self):
+        ctrl = ReplicationModeController(
+            self._est(), min_dwell=1, min_gap_events=32
+        )
+        assert ctrl.decide(31) == MODE_EVENTS
+        assert ctrl.switches == 0
+
+    def test_hysteresis_prevents_flapping(self):
+        est = self._est()
+        ctrl = ReplicationModeController(
+            est, hysteresis=1.5, min_dwell=1, min_gap_events=5
+        )
+        assert ctrl.decide(100) == MODE_SNAPSHOT
+        # borderline gap: events is nominally cheaper (t_events=0.1 <
+        # t_snap=0.11) but not by the hysteresis factor — the mode
+        # must hold
+        for _ in range(5):
+            assert ctrl.decide(10) == MODE_SNAPSHOT
+        assert ctrl.switches == 1
+        # a decisively faster link flips it back (and only once)
+        est.observe_transfer(3_000_000, 1.0)
+        assert ctrl.decide(20) == MODE_EVENTS
+        assert ctrl.switches == 2
+
+    def test_force_mode_pins_the_decision(self):
+        ctrl = ReplicationModeController(
+            self._est(), force_mode=MODE_SNAPSHOT
+        )
+        assert ctrl.decide(1) == MODE_SNAPSHOT
+        assert ctrl.switches == 0
+
+    def test_switch_emits_metrics(self):
+        scope = Scope()
+        ctrl = ReplicationModeController(
+            self._est(), min_dwell=1, min_gap_events=5, metrics=scope
+        )
+        assert ctrl.decide(100) == MODE_SNAPSHOT
+        reg = scope.registry
+        assert reg.counter_value("replication_mode_switches") == 1
+
+
+# ---------------------------------------------------------------------------
+# pump backoff + durable progress
+# ---------------------------------------------------------------------------
+
+
+class _HealableClient:
+    """Raises until ``ok`` is flipped; counts calls."""
+
+    def __init__(self):
+        self.calls = 0
+        self.ok = False
+        self._lock = threading.Lock()
+
+    def get_replication_messages(self, shard_id, last_retrieved_id):
+        with self._lock:
+            self.calls += 1
+        if not self.ok:
+            raise ConnectionError("[test] link down")
+        return ReplicationMessages(tasks=[], last_retrieved_id=0)
+
+
+def _bare_shard(bundle):
+    return SimpleNamespace(
+        shard_id=0, persistence=bundle,
+        set_remote_cluster_current_time=lambda *a: None,
+    )
+
+
+class TestPumpBackoff:
+    def test_dead_link_backs_off_capped_then_resets_on_success(self):
+        bundle = create_memory_bundle()
+        client = _HealableClient()
+        scope = Scope()
+        proc = ReplicationTaskProcessor(
+            _bare_shard(bundle), replicator=None,
+            fetcher=ReplicationTaskFetcher("remote", client),
+            metrics=scope, backoff_max_s=0.2,
+        )
+        proc.start(interval_s=0.01)
+        try:
+            time.sleep(0.9)
+            dead_calls = client.calls
+            # a fixed 10ms cadence would burn ~90 cycles; the ladder
+            # (10→20→40→80→160→200ms, jittered down to half) caps the
+            # retry count — the log-spam satellite's exact contract
+            assert dead_calls <= 30, dead_calls
+            # the pump may sit between its fetch and the counter bump
+            # when we read — allow the one-in-flight cycle
+            backoffs = scope.registry.counter_value(
+                "replication_pump_backoffs")
+            assert dead_calls - 1 <= backoffs <= dead_calls, (
+                dead_calls, backoffs,
+            )
+            # heal: the FIRST successful cycle resets the ladder, so
+            # the pull cadence recovers to ~interval_s immediately
+            client.ok = True
+            time.sleep(0.6)
+            healed_calls = client.calls - dead_calls
+            assert healed_calls >= 10, (dead_calls, healed_calls)
+        finally:
+            proc.stop()
+
+
+class TestDurableProgress:
+    class _Client:
+        def __init__(self, last_id):
+            self.last_id = last_id
+
+        def get_replication_messages(self, shard_id, last_retrieved_id):
+            return ReplicationMessages(
+                tasks=[], last_retrieved_id=self.last_id
+            )
+
+    def test_cursor_persists_and_restores_across_processors(self):
+        bundle = create_memory_bundle()
+        shard = _bare_shard(bundle)
+        proc = ReplicationTaskProcessor(
+            shard, replicator=None,
+            fetcher=ReplicationTaskFetcher("remote", self._Client(57)),
+        )
+        assert proc.process_once() == 0
+        row = bundle.shard.get_replication_progress(0, "remote")
+        assert row is not None and row[0] == 1
+        assert '"applied_through": 57' in row[1]
+        assert '"mode": "events"' in row[1]
+
+        # a fresh processor (restart) resumes the fetch cursor from the
+        # durable row instead of re-pulling from task id 0
+        fetcher2 = ReplicationTaskFetcher("remote", self._Client(57))
+        ReplicationTaskProcessor(
+            shard, replicator=None, fetcher=fetcher2,
+        )
+        assert fetcher2.last_retrieved(0) == 57
+
+    def test_backfill_debt_survives_restart_with_the_cursor(self):
+        """The byte-identity debt of snapshot shipping must be exactly
+        as durable as the cursor that fast-forwards past it: owed
+        ranges ride the progress blob and a restarted processor
+        re-queues them (a dropped deque would leave the standby
+        permanently missing the covered history prefix)."""
+        bundle = create_memory_bundle()
+        shard = _bare_shard(bundle)
+        proc = ReplicationTaskProcessor(
+            shard, replicator=None,
+            fetcher=ReplicationTaskFetcher("remote", self._Client(9)),
+        )
+        proc._enqueue_backfill("dom", "wf-1", "run-1", 1, 40)
+        proc._persist_progress()  # the catch-up/cycle boundary write
+        row = bundle.shard.get_replication_progress(0, "remote")
+        assert row is not None
+        assert '["dom", "wf-1", "run-1", 1, 40]' in row[1], row
+
+        proc2 = ReplicationTaskProcessor(
+            shard, replicator=None,
+            fetcher=ReplicationTaskFetcher("remote", self._Client(9)),
+        )
+        assert list(proc2._backfill) == [("dom", "wf-1", "run-1", 1, 40)]
+        # the restored debt doesn't re-persist a no-op version bump
+        version_before = bundle.shard.get_replication_progress(
+            0, "remote")[0]
+        proc2._persist_progress()
+        assert bundle.shard.get_replication_progress(
+            0, "remote")[0] == version_before
+
+    def test_cursor_only_persists_forward_progress(self):
+        bundle = create_memory_bundle()
+        shard = _bare_shard(bundle)
+        proc = ReplicationTaskProcessor(
+            shard, replicator=None,
+            fetcher=ReplicationTaskFetcher("remote", self._Client(5)),
+        )
+        proc.process_once()
+        proc.process_once()  # same cursor: no second version bump
+        assert bundle.shard.get_replication_progress(0, "remote")[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# metric-name coverage (REPLICATION_METRICS is the contract)
+# ---------------------------------------------------------------------------
+
+
+def test_replication_metrics_tuple_covers_everything_emitted():
+    """Every replication_* metric the transport planes emit must be
+    declared in utils.metrics_defs.REPLICATION_METRICS — the operator
+    catalog can never silently trail the code."""
+    import os
+    import re
+
+    import cadence_tpu.runtime.replication as repl_pkg
+    from cadence_tpu.utils.metrics_defs import REPLICATION_METRICS
+
+    pkg_dir = os.path.dirname(repl_pkg.__file__)
+    emitted = set()
+    pattern = re.compile(
+        r"\.(?:inc|gauge|record)\(\s*[\"'](replication_[a-z_]+)[\"']"
+    )
+    for name in os.listdir(pkg_dir):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(pkg_dir, name)) as f:
+            src = f.read()
+        emitted.update(pattern.findall(src))
+    assert emitted, "scan found no replication metric emissions"
+    undeclared = emitted - set(REPLICATION_METRICS)
+    assert not undeclared, (
+        f"emitted but missing from REPLICATION_METRICS: "
+        f"{sorted(undeclared)}"
+    )
+    # and the adaptive-transport names the README documents are real
+    for required in (
+        "replication_lag_events", "replication_lag_seconds",
+        "replication_mode", "replication_mode_switches",
+        "replication_bytes_shipped", "replication_snapshots_shipped",
+        "replication_snapshot_fallbacks", "replication_backfill_events",
+        "replication_pump_backoffs",
+    ):
+        assert required in REPLICATION_METRICS, required
+        assert required in emitted, (
+            f"{required} declared but never emitted"
+        )
