@@ -13,6 +13,10 @@ Three passes, run together by ``python -m cadence_tpu.analysis``:
 3. **lock order** (lock_order.py) — the runtime's lock graph:
    acquisition-order inversions and blocking work (store I/O, sleeps,
    joins, foreign waits) done while holding a lock.
+4. **metrics** (metric_decl.py) — every literal metric emission under
+   runtime/ops/matching/checkpoint must be declared in a
+   utils/metrics_defs.py catalog (rule METRIC-UNDECLARED): the
+   operator docs can never silently trail the code.
 
 Findings gate against a checked-in baseline
 (config/lint_baseline.json): accepted findings carry a one-line
@@ -26,7 +30,7 @@ from typing import Dict, List, Optional
 
 from .findings import Baseline, BaselineEntry, Finding, dedupe
 
-PASSES = ("surface", "jit", "locks")
+PASSES = ("surface", "jit", "locks", "metrics")
 
 
 def run_pass(name: str, repo_root: str) -> List[Finding]:
@@ -42,6 +46,10 @@ def run_pass(name: str, repo_root: str) -> List[Finding]:
         from . import lock_order
 
         return lock_order.run(repo_root)
+    if name == "metrics":
+        from . import metric_decl
+
+        return metric_decl.run(repo_root)
     raise ValueError(f"unknown pass {name!r} (have: {PASSES})")
 
 

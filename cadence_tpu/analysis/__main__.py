@@ -1,7 +1,7 @@
 """CLI for the static-analysis gate.
 
     python -m cadence_tpu.analysis [--baseline config/lint_baseline.json]
-                                   [--passes surface,jit,locks]
+                                   [--passes surface,jit,locks,metrics]
                                    [--emit-matrix PATH]
                                    [--write-baseline PATH]
                                    [--root DIR]
@@ -35,7 +35,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--passes", default=None,
-        help="comma-separated subset of passes (surface,jit,locks)",
+        help="comma-separated subset of passes (surface,jit,locks,metrics)",
     )
     ap.add_argument(
         "--emit-matrix", default=None, metavar="PATH",

@@ -74,14 +74,31 @@ class HistoryClient:
         shapes — the controller's (no local handle) and the persistence
         rangeID-fencing sibling raised mid-call by a fenced/stolen
         shard — re-resolve and retry instead of surfacing to callers
-        (frontends saw the raw error during any ownership change)."""
+        (frontends saw the raw error during any ownership change).
+        Retried attempts ride the active trace as ``retry`` spans
+        (utils/tracing.py), so a chaos/reshard run's recovery path is
+        readable off the flight recorder instead of correlated from
+        logs."""
+        from cadence_tpu.utils.tracing import TRACER
+
         last_err = None
         for attempt in range(_OWNERSHIP_RETRY):
             if attempt:
                 time.sleep(_ownership_backoff_s(attempt))
             try:
-                engine = self._engine_for(workflow_id)
-                return getattr(engine, method)(*args, **kwargs)
+                if attempt == 0:
+                    engine = self._engine_for(workflow_id)
+                    return getattr(engine, method)(*args, **kwargs)
+                with TRACER.span(
+                    f"retry.{method}", service="history_client",
+                    attempt=attempt,
+                ) as span:
+                    span.annotate(
+                        f"ownership_lost retry attempt={attempt} "
+                        f"({type(last_err).__name__})"
+                    )
+                    engine = self._engine_for(workflow_id)
+                    return getattr(engine, method)(*args, **kwargs)
             except (ShardOwnershipLostError,
                     PersistenceShardOwnershipLost) as e:
                 last_err = e

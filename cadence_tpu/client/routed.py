@@ -71,6 +71,25 @@ def is_routed_retryable(e: Exception) -> bool:
     return False
 
 
+def _traced_attempts(fn, method: str):
+    """Wrap a retried thunk so re-resolution attempts annotate the
+    active trace (utils/tracing.py) — no active trace, no cost beyond
+    an int increment."""
+    from cadence_tpu.utils.tracing import TRACER
+
+    state = {"n": 0}
+
+    def attempt():
+        state["n"] += 1
+        if state["n"] > 1:
+            TRACER.annotate(
+                f"routed retry attempt={state['n'] - 1} op={method}"
+            )
+        return fn()
+
+    return attempt
+
+
 class _StubCache:
     def __init__(self, factory) -> None:
         self._factory = factory
@@ -154,7 +173,11 @@ class RoutedHistoryClient(HistoryClient):
 
     def _call(self, workflow_id: str, method: str, *args, **kwargs):
         return retry(
-            lambda: self._call_once(workflow_id, method, *args, **kwargs),
+            _traced_attempts(
+                lambda: self._call_once(workflow_id, method, *args,
+                                        **kwargs),
+                method,
+            ),
             policy=self.retry_policy,
             is_retriable=is_routed_retryable,
         )
@@ -196,8 +219,11 @@ class RoutedMatchingClient(MatchingClient):
     def _invoke(self, task_list: str, method: str, *args, **kwargs):
         # each attempt re-resolves the ring (retryableClient.go parity)
         return retry(
-            lambda: getattr(self._engine_for(task_list), method)(
-                *args, **kwargs
+            _traced_attempts(
+                lambda: getattr(self._engine_for(task_list), method)(
+                    *args, **kwargs
+                ),
+                method,
             ),
             policy=self.retry_policy,
             is_retriable=is_routed_retryable,

@@ -114,6 +114,19 @@ def start_services(
 
     persistence = persistence or _build_persistence(cfg)
 
+    # telemetry section first: one metrics scope per host (registry
+    # series cap from telemetry.maxSeries) shared by every service
+    # plane, and the process tracer configured before any handler is
+    # instrumented (utils/tracing.py; sampleRate 0 = no implicit roots).
+    # The persistence bundle is always metrics-wrapped now — per-store
+    # histogram latencies and the persistence hop of request traces are
+    # a production surface, not a chaos-only one.
+    from cadence_tpu.runtime.persistence.decorators import wrap_bundle
+    from cadence_tpu.utils.metrics import Registry, Scope
+
+    metrics = Scope(Registry(max_series=cfg.telemetry.max_series))
+    cfg.telemetry.apply(metrics=metrics)
+
     # chaos section: fault-inject the whole persistence bundle before
     # anything else sees it, so every service plane on this host runs
     # against the same deterministic fault stream. The schedule, the
@@ -121,15 +134,10 @@ def start_services(
     # scope so faults_injected and the injected-error counters land in
     # the same registry operators already read (metrics_defs.py
     # FAULT_METRICS promise)
-    metrics = None
     faults = None
     if cfg.chaos.enabled:
-        from cadence_tpu.runtime.persistence.decorators import wrap_bundle
-        from cadence_tpu.utils.metrics import Scope
-
-        metrics = Scope()
         faults = cfg.chaos.build_schedule(metrics=metrics)
-        persistence = wrap_bundle(persistence, metrics=metrics, faults=faults)
+    persistence = wrap_bundle(persistence, metrics=metrics, faults=faults)
 
     # checkpoint section: incremental-replay snapshots over the
     # bundle's checkpoint store. Built AFTER the chaos wrap, so a
@@ -248,7 +256,9 @@ def start_services(
 
     matching = None
     if "matching" in services:
-        matching = MatchingEngine(persistence.task, hc, config=dyncfg)
+        matching = MatchingEngine(
+            persistence.task, hc, config=dyncfg, metrics=metrics
+        )
         out.matching = matching
     mc = RoutedMatchingClient(
         monitor, matching, local_identity=addr("matching")
@@ -273,7 +283,8 @@ def start_services(
 
             visibility = AdvancedVisibilityStore(persistence.visibility)
         out.frontend = WorkflowHandler(
-            out.domain_handler, domains, hc, mc, visibility=visibility
+            out.domain_handler, domains, hc, mc, visibility=visibility,
+            metrics=metrics,
         )
         out.admin = (
             AdminHandler(history, domains, bus=out.bus)

@@ -243,6 +243,41 @@ class ReplicationConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Unified telemetry plane (utils/tracing.py + utils/metrics.py).
+
+    ``sampleRate`` is the probability an RPC endpoint roots a new trace
+    for a request that arrived without one (0.0, the default, disables
+    implicit roots entirely — explicitly started traces still record);
+    ``traceCapacity`` bounds the flight-recorder ring buffer (spans);
+    ``maxSeries`` caps per-registry metric-series cardinality (overflow
+    collapses into the ``overflow="true"`` sink and bumps
+    ``metrics_dropped_series``). The unsampled path stays a thread-local
+    read — the bench ``telemetry_overhead`` guard pins it at ≤3%."""
+
+    sample_rate: float = 0.0
+    trace_capacity: int = 4096
+    max_series: int = 8192
+
+    def validate(self) -> None:
+        if not 0.0 <= self.sample_rate <= 1.0:
+            raise ConfigError("telemetry.sampleRate must be in [0, 1]")
+        if self.trace_capacity < 1:
+            raise ConfigError("telemetry.traceCapacity must be >= 1")
+        if self.max_series < 1:
+            raise ConfigError("telemetry.maxSeries must be >= 1")
+
+    def apply(self, metrics=None):
+        """Configure the process tracer from this section; returns it."""
+        from cadence_tpu.utils.tracing import configure
+
+        return configure(
+            sample_rate=self.sample_rate, capacity=self.trace_capacity,
+            metrics=metrics,
+        )
+
+
+@dataclasses.dataclass
 class ServerConfig:
     persistence: PersistenceConfig = dataclasses.field(
         default_factory=PersistenceConfig
@@ -262,6 +297,9 @@ class ServerConfig:
     replication: ReplicationConfig = dataclasses.field(
         default_factory=ReplicationConfig
     )
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
     dynamicconfig_path: str = ""
     archival_dir: str = ""
 
@@ -272,6 +310,7 @@ class ServerConfig:
         self.checkpoint.validate()
         self.resharding.validate()
         self.replication.validate()
+        self.telemetry.validate()
         for name in self.services:
             if name not in SERVICES:
                 raise ConfigError(f"services: unknown service '{name}'")
@@ -395,6 +434,14 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "snapshotBytesPrior": "snapshot_bytes_prior",
             "backoffMaxSeconds": "backoff_max_s",
         }, "replication"))
+
+    tel = raw.pop("telemetry", None)
+    if tel:
+        cfg.telemetry = TelemetryConfig(**_take(tel, {
+            "sampleRate": "sample_rate",
+            "traceCapacity": "trace_capacity",
+            "maxSeries": "max_series",
+        }, "telemetry"))
 
     dc = raw.pop("dynamicConfig", None)
     if dc:

@@ -118,6 +118,15 @@ class AdminHandler:
     ) -> int:
         return self._require_bus().dlq_merge(topic, last_message_id)
 
+    def dump_traces(self, trace_id: str = "") -> Dict[str, Any]:
+        """The tracing flight recorder (utils/tracing.py) as
+        Chrome-trace-format JSON — the RPC twin of
+        ``GET /debug/pprof/traces``. ``trace_id`` filters to one
+        request's trace; empty dumps the whole ring buffer."""
+        from cadence_tpu.utils.tracing import TRACER
+
+        return TRACER.chrome_trace(trace_id or None)
+
     def describe_history_host(self) -> Dict[str, Any]:
         desc = self.history.describe()
         desc["host"] = self.history.monitor.self_identity

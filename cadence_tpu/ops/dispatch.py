@@ -41,10 +41,51 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Iterator, List, Optional, Sequence, Tuple
+
+from cadence_tpu.utils.metrics import NOOP, Scope
 
 from . import schema as S
 from .pack import round_scan_len
+
+
+def _jit_cache_total() -> int:
+    """Total compiled-executable count across the replay kernels a
+    dispatcher can route to (jax keeps a per-jit cache; its growth IS a
+    retrace). -1 when the introspection API is unavailable — telemetry
+    must degrade, never break dispatch."""
+    total = 0
+    try:
+        from .assoc import _assoc_core
+        from .replay import replay_scan_jit, replay_scan_packed_jit
+
+        for fn in (replay_scan_jit, replay_scan_packed_jit, _assoc_core):
+            size = getattr(fn, "_cache_size", None)
+            if size is not None:
+                total += int(size())
+    except Exception:
+        return -1
+    return total
+
+
+# retrace baseline at MODULE scope, matching the process-global jit
+# caches it reads: serving builds a fresh dispatcher per rebuild_many
+# call, and a per-dispatcher baseline would re-seed every call — a
+# retrace storm crossing dispatcher lifetimes (the common one-batch
+# serving shape) would never increment jit_retraces
+_jit_baseline_lock = threading.Lock()
+_jit_entries_prev: Optional[int] = None
+
+
+def _jit_retrace_delta(entries: int) -> int:
+    global _jit_entries_prev
+    with _jit_baseline_lock:
+        prev = _jit_entries_prev
+        _jit_entries_prev = entries
+    if prev is not None and entries > prev:
+        return entries - prev
+    return 0
 
 
 class DispatchError(Exception):
@@ -107,8 +148,22 @@ class DeviceDispatcher:
         lane_pack: bool = False,
         lane_len: Optional[int] = None,
         scan_mode: str = "auto",
+        metrics: Optional[Scope] = None,
     ) -> None:
         self.caps = caps or S.Capacities()
+        # device-step telemetry (utils/metrics_defs.py DEVICE_METRICS):
+        # per-batch stage/step timings, padding waste, lane occupancy,
+        # batch-width histogram and jit-cache growth, tagged by kernel
+        # and staging mode. None OR the shared NOOP sentinel (both mean
+        # "no metrics wired") disables the whole plane — the pumps
+        # check one bool and skip every measurement, including the
+        # block_until_ready that honest device timing needs (the run
+        # pump otherwise rides async dispatch; a caller passing NOOP
+        # must not pay that pipelining loss for discarded data).
+        self._telemetry = metrics is not None and metrics is not NOOP
+        self._metrics = (metrics if metrics is not None else NOOP).tagged(
+            layer="device"
+        )
         # which time-axis kernel the run pump uses:
         #   "scan"  — the sequential O(T)-depth kernels everywhere.
         #   "assoc" — the parallel-in-time associative path
@@ -221,6 +276,7 @@ class DeviceDispatcher:
                 return
             batch_id, histories, resume = item
             try:
+                t0 = _time.perf_counter()
                 if self.lane_pack:
                     staged = self._pack_lanes_item(
                         batch_id, histories, use_pallas, jax, jnp,
@@ -231,11 +287,73 @@ class DeviceDispatcher:
                         batch_id, histories, use_pallas, jax, jnp,
                         resume=resume,
                     )
+                if self._telemetry:
+                    self._emit_stage_telemetry(
+                        staged, histories, use_pallas,
+                        _time.perf_counter() - t0,
+                    )
                 # blocks when `depth` batches are already staged — the
                 # double-buffer backpressure
                 self._staged.put(staged)
             except Exception as e:
                 self._staged.put(DispatchError(batch_id, e))
+
+    def _device_scope(self, mode: str, use_pallas: bool) -> Scope:
+        return self._metrics.tagged(
+            kernel="pallas" if use_pallas else "xla", mode=mode,
+        )
+
+    def _emit_stage_telemetry(
+        self, staged, histories, use_pallas: bool, stage_s: float,
+    ) -> None:
+        """Per-batch staging telemetry (pack + H2D build time, padding
+        waste, lane occupancy, width histogram) — only reached when a
+        metrics scope was wired (``self._telemetry``)."""
+        mode, packed = staged[0], staged[2]
+        scope = self._device_scope(mode, use_pallas)
+        scope.inc("device_batches")
+        scope.record("host_stage_seconds", stage_s)
+        if mode.startswith("lanes"):
+            # the packer's own waste/occupancy definitions — one source
+            # of truth with bench.py and the PackedLanes properties
+            padding = packed.padding_frac
+            width = packed.lanes
+            if packed.lanes:
+                scope.gauge(
+                    "lane_occupancy", packed.n_histories / packed.lanes
+                )
+        else:
+            cells = packed.batch * packed.events.shape[1]
+            real = sum(history_depth(h[2]) for h in histories)
+            padding = (cells - real) / max(real, 1)
+            width = packed.batch
+        scope.gauge("padding_frac", padding)
+        # batches counted per grid-rounded width: the compiled-
+        # executable set in action (width cardinality is bounded by the
+        # round_scan_len geometric grid, so the tag can't explode)
+        scope.tagged(width=str(width)).inc("batch_width")
+
+    def _emit_step_telemetry(
+        self, mode: str, use_pallas: bool, final, t0: float,
+    ) -> None:
+        """Per-batch device-step telemetry. Blocks on ``final`` so the
+        recorded duration is device time, not async-dispatch time —
+        the documented cost of enabling device telemetry (the pack pump
+        still overlaps; only kernel-launch pipelining is lost)."""
+        try:
+            import jax
+
+            jax.block_until_ready(final)
+        except Exception:
+            pass
+        scope = self._device_scope(mode, use_pallas)
+        scope.record("device_step_seconds", _time.perf_counter() - t0)
+        entries = _jit_cache_total()
+        if entries >= 0:
+            self._metrics.gauge("jit_cache_entries", entries)
+            delta = _jit_retrace_delta(entries)
+            if delta:
+                self._metrics.inc("jit_retraces", delta)
 
     def _assoc_enabled(self, use_pallas: bool) -> bool:
         """Can any batch ride the associative kernels on this host?
@@ -438,6 +556,7 @@ class DeviceDispatcher:
                 continue
             mode, batch_id = item[0], item[1]
             try:
+                t0 = _time.perf_counter()
                 if mode == "hist_assoc":
                     _, _, packed, events, state0, sig, b = item
                     from .assoc import _assoc_core
@@ -527,6 +646,9 @@ class DeviceDispatcher:
                         )
                 # async dispatch: the call returns while the device
                 # works; the next H2D/pack proceeds immediately
+                # (telemetry mode trades that for honest step timing)
+                if self._telemetry:
+                    self._emit_step_telemetry(mode, use_pallas, final, t0)
                 self._out.put((batch_id, packed, final))
             except Exception as e:
                 self._out.put(DispatchError(batch_id, e))
@@ -607,6 +729,7 @@ def replay_stream(
     bucket: bool = False,
     resume: Optional[Sequence] = None,
     scan_mode: str = "auto",
+    metrics: Optional[Scope] = None,
 ) -> List[Tuple]:
     """Replay a large history stream through the pipelined dispatcher.
 
@@ -633,7 +756,7 @@ def replay_stream(
     if bucket:
         d = DeviceDispatcher(
             caps=caps, depth=depth, kernel=kernel, lane_pack=True,
-            lane_len=lane_len, scan_mode=scan_mode,
+            lane_len=lane_len, scan_mode=scan_mode, metrics=metrics,
         )
         n = 0
         for idxs, hs in depth_buckets(histories):
@@ -652,7 +775,7 @@ def replay_stream(
         return out
     d = DeviceDispatcher(
         caps=caps, depth=depth, kernel=kernel, lane_pack=lane_pack,
-        lane_len=lane_len, scan_mode=scan_mode,
+        lane_len=lane_len, scan_mode=scan_mode, metrics=metrics,
     )
     n = 0
     for i in range(0, len(histories), batch_size):

@@ -26,8 +26,16 @@ class _Method:
         )
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        # trace propagation (utils/tracing.py): a sampled caller's
+        # context rides the metadata so the receiving host's spans join
+        # the same trace; with no active trace this is one thread-local
+        # read returning the metadata unchanged (None)
+        from cadence_tpu.utils.tracing import inject_metadata
+
         try:
-            return self._call((list(args), kwargs))["r"]
+            return self._call(
+                (list(args), kwargs), metadata=inject_metadata()
+            )["r"]
         except grpc.RpcError as e:
             details = e.details() or ""
             cls_name, _, msg = details.partition(": ")

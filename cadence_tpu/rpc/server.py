@@ -71,10 +71,27 @@ class _Generic(grpc.GenericRpcHandler):
         if fn is None:
             return None
 
+        service_short = self._service.rsplit(".", 1)[-1].lower()
+
         def handler(request, context):
+            from cadence_tpu.utils.tracing import TRACER, extract_metadata
+
             args, kwargs = request
+            # trace propagation: a caller-shipped context parents this
+            # server's span (the cross-process hop of one trace); with
+            # no inbound context the endpoint MAY root a new trace at
+            # the configured sample rate (telemetry: YAML section) —
+            # rate 0 (the default) makes this a no-op span
+            ctx = extract_metadata(context.invocation_metadata())
+            if ctx is not None:
+                span = TRACER.span(
+                    f"rpc.{name}", service=service_short, parent=ctx
+                )
+            else:
+                span = TRACER.trace(f"rpc.{name}", service=service_short)
             try:
-                return fn(*args, **kwargs)
+                with span:
+                    return fn(*args, **kwargs)
             except Exception as e:
                 cls = type(e).__name__
                 code = ERROR_CODES.get(cls, grpc.StatusCode.INTERNAL)

@@ -114,6 +114,14 @@ class HistoryEngine:
 
     def _publish_progress(self, ms: MutableState) -> None:
         ei = ms.execution_info
+        # trace joining for the asynchronous hops: bind this workflow to
+        # the caller's (sampled) trace so the queue tasks this persist
+        # just scheduled — processed later on pump threads — land in
+        # the SAME trace (utils/tracing.py; queues/base.task_span does
+        # the lookup). No active trace → one thread-local read, no bind.
+        from cadence_tpu.utils.tracing import TRACER
+
+        TRACER.bind(("wf", ei.workflow_id))
         self.event_notifier.notify(
             ei.domain_id, ei.workflow_id, ei.run_id,
             ms.next_event_id, ms.is_workflow_execution_running(),

@@ -44,19 +44,33 @@ class _Wrapped:
 
 
 class MetricsClient(_Wrapped):
-    """Latency + error counters per persistence API."""
+    """Latency + error counters per persistence API, plus a trace span
+    per call when the calling thread carries a sampled trace
+    (utils/tracing.py) — the store hop of the end-to-end request trace.
+    The untraced path adds one current-span check; the span name and
+    the span machinery are only built when a trace is live."""
 
     def __init__(self, base: Any, metrics: Scope = NOOP,
                  manager: str = "") -> None:
         super().__init__(base)
+        self._manager = manager or type(base).__name__
         self._metrics = metrics.tagged(
-            layer="persistence", manager=manager or type(base).__name__
+            layer="persistence", manager=self._manager
         )
 
     def _invoke(self, name, method, args, kwargs):
+        from cadence_tpu.utils.tracing import NOOP_SPAN, TRACER
+
+        span = (
+            NOOP_SPAN if TRACER.current() is None
+            else TRACER.span(
+                f"{self._manager}.{name}", service="persistence"
+            )
+        )
         start = time.monotonic()
         try:
-            out = method(*args, **kwargs)
+            with span:
+                out = method(*args, **kwargs)
         except Exception as e:
             self._metrics.inc(f"{name}.errors")
             self._metrics.inc(f"{name}.errors.{type(e).__name__}")

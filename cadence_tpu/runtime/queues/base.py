@@ -19,6 +19,7 @@ import time as _time
 from cadence_tpu.runtime.api import EntityNotExistsServiceError
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP, Scope
+from cadence_tpu.utils.tracing import NOOP_SPAN, TRACER
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, defer_task
@@ -183,6 +184,28 @@ def timed_task(metrics: Scope, task):
         scope.record("task_latency", _time.perf_counter() - t0)
 
 
+def task_span(queue_name: str, task):
+    """Join the workflow's trace for one queue-task execution.
+
+    Queue tasks run on pump-pool threads, so thread-local propagation
+    cannot reach them; the engine binds ``("wf", workflow_id) →
+    TraceContext`` at persist time (utils/tracing.py) and this lookup
+    reconnects the asynchronous hop — the span (and everything the task
+    does in this thread: persistence calls, matching add-task, fault
+    annotations) lands in the SAME trace the frontend request started.
+    No binding (the overwhelmingly common unsampled case) costs one
+    len() check and returns the shared no-op. Shared by the active and
+    standby processor families plus replication apply."""
+    ctx = TRACER.lookup(("wf", getattr(task, "workflow_id", None)))
+    if ctx is None:
+        return NOOP_SPAN
+    return TRACER.span(
+        f"queue.{queue_name}", service="history_queue", parent=ctx,
+        task_type=str(getattr(task, "task_type", "?")),
+        task_id=getattr(task, "task_id", ""),
+    )
+
+
 class QueueProcessorBase:
     def __init__(
         self,
@@ -328,7 +351,8 @@ class QueueProcessorBase:
                 return
 
     def _run_task(self, task, key) -> None:
-        with timed_task(self._metrics, task) as scope:
+        with task_span(self.name, task), \
+                timed_task(self._metrics, task) as scope:
             finished = run_task_attempts(
                 self._process_task, task, key, self.ack, self._stopped,
                 self._log, scope, self.name,

@@ -51,6 +51,7 @@ from .base import (
     read_due_timers,
     run_task_attempts,
     sweep_ack,
+    task_span,
     timed_task,
 )
 from .timer_gate import RemoteTimerGate
@@ -561,7 +562,8 @@ class TimerQueueStandbyProcessor:
             self.gate.update(future[0].visibility_timestamp)
 
     def _run_task(self, task: TimerTask, key) -> None:
-        with timed_task(self._metrics, task) as scope:
+        with task_span(self.name, task), \
+                timed_task(self._metrics, task) as scope:
             finished = run_task_attempts(
                 self._process, task, key, self.ack, self._stopped,
                 self._log, scope, self.name,

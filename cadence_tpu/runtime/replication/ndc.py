@@ -118,18 +118,24 @@ class NDCHistoryReplicator:
             raise ValueError("replication task has no events")
         if self._fault_hook is not None:
             self._fault_hook("apply_events", self.shard.shard_id)
-        ctx = self.cache.get_or_create(
-            task.domain_id, task.workflow_id, task.run_id
-        )
-        with ctx.lock:
-            try:
-                ms = ctx.load()
-            except EntityNotExistsError:
-                self._apply_for_new_workflow(ctx, task)
-                return None
-            return self._apply_for_existing(
-                ctx, ms, task, _defer_rebuild=_defer_rebuild
+        # replication apply runs on the pull-pump thread; the same
+        # workflow-keyed binding the queue pumps use joins this apply to
+        # the workflow's sampled trace, if one exists (utils/tracing.py)
+        from cadence_tpu.runtime.queues.base import task_span
+
+        with task_span("replication-apply", task):
+            ctx = self.cache.get_or_create(
+                task.domain_id, task.workflow_id, task.run_id
             )
+            with ctx.lock:
+                try:
+                    ms = ctx.load()
+                except EntityNotExistsError:
+                    self._apply_for_new_workflow(ctx, task)
+                    return None
+                return self._apply_for_existing(
+                    ctx, ms, task, _defer_rebuild=_defer_rebuild
+                )
 
     def apply_events_batch(self, tasks) -> None:
         """Batched drain: apply a fetched cycle's tasks, routing every

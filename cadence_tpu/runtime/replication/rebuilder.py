@@ -81,7 +81,10 @@ class StateRebuilder:
         # checkpoint.CheckpointManager (or None: every rebuild is cold)
         self.checkpoints = checkpoints
         # checkpoint_hit/miss/invalidated + events_replayed_saved land
-        # here (utils/metrics_defs.py CHECKPOINT_METRICS)
+        # here (utils/metrics_defs.py CHECKPOINT_METRICS); the raw scope
+        # also feeds the dispatcher's device-step telemetry
+        # (DEVICE_METRICS) — None disables both planes together
+        self._raw_metrics = metrics
         self._metrics = (metrics if metrics is not None else NOOP).tagged(
             layer="checkpoint"
         )
@@ -229,7 +232,7 @@ class StateRebuilder:
         )
         d = DeviceDispatcher(
             domain_resolver=self.domain_resolver, lane_pack=True,
-            lane_len=self.lane_len,
+            lane_len=self.lane_len, metrics=self._raw_metrics,
         )
 
         # consult checkpoints, read only what must be replayed

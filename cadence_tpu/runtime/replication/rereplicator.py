@@ -23,6 +23,7 @@ import time
 from typing import List, Optional
 
 from cadence_tpu.core.events import HistoryEvent
+from cadence_tpu.utils import tracing
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP
 
@@ -61,11 +62,17 @@ class HistoryRereplicator:
                 if self._snapshot_recover(err):
                     return 0
                 self._metrics.inc("replication_snapshot_fallbacks")
+                tracing.annotate(
+                    f"snapshot_fallback wf={err.workflow_id}"
+                )
             except Exception:
                 # torn snapshot transfer / partitioned link mid-blob:
                 # the event path below re-fetches through the same
                 # (possibly still degraded) link and stays correct
                 self._metrics.inc("replication_snapshot_fallbacks")
+                tracing.annotate(
+                    f"snapshot_fallback wf={err.workflow_id} (torn)"
+                )
                 logger.exception(
                     "snapshot recovery failed; falling back to event "
                     "shipping",

@@ -122,9 +122,13 @@ class HistoryService:
     # -- per-shard assembly --------------------------------------------
 
     def _build_shard(self, shard: ShardContext) -> _ShardHandle:
-        engine = HistoryEngine(shard, self.domains)
+        # metrics must ride the CONSTRUCTOR: instrument_methods wraps
+        # the per-op triple (and trace spans) at __init__ time, so a
+        # post-construction `engine.metrics = ...` left every history
+        # API latency in the NOOP registry (found by the telemetry
+        # verification drive — p50/p99 read 0 forever)
+        engine = HistoryEngine(shard, self.domains, metrics=self.metrics)
         engine.cluster_metadata = self.cluster_metadata
-        engine.metrics = self.metrics
         engine.rebuild_chunk_size = self.rebuild_chunk_size
         engine.faults = self.faults
         engine.checkpoints = self.checkpoints

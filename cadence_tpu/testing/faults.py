@@ -67,6 +67,7 @@ from cadence_tpu.runtime.persistence.errors import (
     PersistenceError,
     ShardOwnershipLostError,
 )
+from cadence_tpu.utils import tracing
 from cadence_tpu.utils.metrics import NOOP, Scope
 
 ACTIONS = ("error", "latency", "torn_write")
@@ -249,6 +250,14 @@ class FaultSchedule:
         self._metrics.tagged(site=site, action=plan.action).inc(
             "faults_injected"
         )
+        # a sampled trace passing through this call site records the
+        # injection as a span annotation (utils/tracing.py) — a chaos
+        # failure's trace shows WHERE the faults landed next to the
+        # retries they caused, instead of hand-correlating logs
+        tracing.annotate(
+            f"fault_injected site={site} method={method} "
+            f"action={plan.action}"
+        )
         return plan
 
     def _build_plan(self, rule, site, method, shard_id) -> _Plan:
@@ -385,6 +394,9 @@ class SimulatedLink:
             jitter = self._rng.random() * p.jitter_s
             if self._partitioned(index):
                 self.partitioned_calls += 1
+                tracing.annotate(
+                    f"link_partitioned transfer={index}"
+                )
                 raise LinkPartitionedError(
                     f"[link-chaos] transfer {index} dropped "
                     f"(partition window)"

@@ -45,22 +45,20 @@ class Onebox:
     ) -> None:
         self.faults = faults
         self.persistence = persistence or create_memory_bundle()
-        if faults is not None:
-            # chaos mode: fault-inject every persistence manager (the
-            # fault client sits innermost, under a metrics client, via
-            # wrap_bundle) — the schedule can be armed/disarmed mid-
-            # workload. The default path installs NOTHING.
-            from cadence_tpu.runtime.persistence.decorators import (
-                wrap_bundle,
-            )
-            from cadence_tpu.utils.metrics import Scope
+        # every Onebox carries a real metrics scope and a metrics-
+        # wrapped bundle: per-store histogram latencies and the
+        # persistence hop of request traces are observable in every
+        # integration test, not just chaos runs (the MetricsClient's
+        # untraced cost is a perf_counter pair per call). Fault
+        # injection (chaos mode) additionally installs the fault client
+        # innermost; the default path installs no fault machinery.
+        from cadence_tpu.runtime.persistence.decorators import wrap_bundle
+        from cadence_tpu.utils.metrics import Scope
 
-            self.metrics = Scope()
-            self.persistence = wrap_bundle(
-                self.persistence, metrics=self.metrics, faults=faults
-            )
-        else:
-            self.metrics = None
+        self.metrics = Scope()
+        self.persistence = wrap_bundle(
+            self.persistence, metrics=self.metrics, faults=faults
+        )
         self.bus = MessageBus()
         self.cluster_metadata = cluster_metadata or ClusterMetadata()
         self.domain_handler = DomainHandler(
@@ -97,6 +95,7 @@ class Onebox:
         self.matching = MatchingEngine(
             self.persistence.task, self.history_client,
             time_source=time_source,
+            metrics=self.metrics,
             poll_request_id_fn=poll_request_id_fn,
         )
         self.matching_client = MatchingClient(self.matching)
@@ -106,6 +105,7 @@ class Onebox:
             self.domain_handler, self.domains,
             self.history_client, self.matching_client,
             visibility=self.visibility,
+            metrics=self.metrics,
         )
         self.admin = AdminHandler(self.history, self.domains, bus=self.bus)
         self.worker: Optional[WorkerService] = None

@@ -1,50 +1,212 @@
-"""Lightweight metrics registry: counters, gauges, timers, scopes.
+"""Lightweight metrics registry: counters, gauges, histogram timers.
 
 The shape of the reference's tally-based metrics layer
 (/root/reference/common/metrics/: Scope with Counter/Timer/Gauge, tagged
 sub-scopes per service/operation/domain) without an external sink:
 in-process aggregation with an introspection API, plus an optional
 snapshot dump. Every runtime layer takes a Scope so per-API and
-per-store latencies are observable in tests and benchmarks."""
+per-store latencies are observable in tests and benchmarks.
+
+Timers are fixed-boundary exponential-bucket histograms (base 1 µs,
+doubling per bucket, 64 buckets ≈ up to 2^63 µs): bounded memory per
+series, one integer increment per record under the registry lock, and
+real percentiles — ``timer_stats`` returns a 3-tuple-compatible
+``TimerStats`` carrying ``p50``/``p95``/``p99``/``avg`` alongside the
+legacy ``(count, total_s, max_s)`` unpacking, and ``quantile(q)`` is
+exact-to-a-bucket (linear interpolation inside the winning bucket,
+clamped to the observed max). The previous ``(count, total, max)``
+tuple could not answer "p99 decision latency under sustained QPS" at
+all; every existing ``Scope.timer`` call site upgrades for free.
+
+Cardinality is bounded: a Registry admits at most ``max_series``
+distinct (name, tags) series per kind; past the cap, new series
+collapse into an ``overflow="true"`` sink series per metric name and
+``metrics_dropped_series`` counts the suppressed writes — a tag
+explosion (e.g. a runaway per-workflow tag) degrades percentile
+attribution, never process memory.
+"""
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 TagTuple = Tuple[Tuple[str, str], ...]
+
+# histogram geometry: bucket i holds values in (2^(i-1), 2^i] µs,
+# bucket 0 holds <= 1 µs. 64 buckets cover any representable latency.
+_BUCKET0_S = 1e-6
+_NBUCKETS = 64
+
+# where writes land once the series cap is hit (per metric name)
+OVERFLOW_TAGS: TagTuple = (("overflow", "true"),)
+DROPPED_SERIES = "metrics_dropped_series"
+
+_DEFAULT_MAX_SERIES = 8192
 
 
 def _tags_key(tags: Optional[Dict[str, str]]) -> TagTuple:
     return tuple(sorted((tags or {}).items()))
 
 
-class Registry:
-    """Process-wide metric store; thread-safe."""
+def _bucket_index(seconds: float) -> int:
+    if seconds <= _BUCKET0_S:
+        return 0
+    # frexp is ~3x cheaper than log2: v = m * 2^e with m in [0.5, 1.0).
+    # An exact power of two comes back as m == 0.5 and belongs to the
+    # LOWER bucket (bounds are (2^(i-1), 2^i], upper-inclusive)
+    m, e = math.frexp(seconds / _BUCKET0_S)
+    if m == 0.5:
+        e -= 1
+    return e if e < _NBUCKETS else _NBUCKETS - 1
+
+
+def bucket_bounds(index: int) -> Tuple[float, float]:
+    """(lo_s, hi_s] covered by bucket ``index`` (diagnostics/tests)."""
+    hi = _BUCKET0_S * (2.0 ** index)
+    lo = 0.0 if index == 0 else hi / 2.0
+    return lo, hi
+
+
+class Histogram:
+    """One series' distribution; NOT thread-safe (the registry lock
+    owns every mutation)."""
+
+    __slots__ = ("counts", "count", "total", "max")
 
     def __init__(self) -> None:
+        self.counts: List[int] = [0] * _NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        if seconds > self.max:
+            self.max = seconds
+        self.counts[_bucket_index(seconds)] += 1
+
+    def merge(self, other: "Histogram") -> None:
+        self.count += other.count
+        self.total += other.total
+        if other.max > self.max:
+            self.max = other.max
+        for i, c in enumerate(other.counts):
+            if c:
+                self.counts[i] += c
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1]: walk to the bucket holding
+        the target rank, interpolate linearly inside it, clamp to the
+        observed max (the top bucket's upper bound is a geometry
+        artifact, not an observation)."""
+        if self.count == 0:
+            return 0.0
+        q = min(max(q, 0.0), 1.0)
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            cum += c
+            if cum >= target:
+                lo, hi = bucket_bounds(i)
+                hi = min(hi, self.max)
+                lo = min(lo, hi)
+                frac = (target - (cum - c)) / c
+                return lo + (hi - lo) * frac
+        return self.max
+
+
+class TimerStats(tuple):
+    """``(count, total_s, max_s)`` — unpacks exactly like the legacy
+    timer tuple — with the histogram-backed extras as attributes:
+    ``p50``/``p95``/``p99``/``avg`` (seconds) and ``quantile(q)``."""
+
+    def __new__(cls, hist: Optional[Histogram] = None):
+        h = hist if hist is not None else Histogram()
+        self = super().__new__(cls, (h.count, h.total, h.max))
+        self._hist = h
+        return self
+
+    @property
+    def count(self) -> int:
+        return self[0]
+
+    @property
+    def total_s(self) -> float:
+        return self[1]
+
+    @property
+    def max_s(self) -> float:
+        return self[2]
+
+    @property
+    def avg(self) -> float:
+        return self[1] / self[0] if self[0] else 0.0
+
+    def quantile(self, q: float) -> float:
+        return self._hist.quantile(q)
+
+    @property
+    def p50(self) -> float:
+        return self._hist.quantile(0.50)
+
+    @property
+    def p95(self) -> float:
+        return self._hist.quantile(0.95)
+
+    @property
+    def p99(self) -> float:
+        return self._hist.quantile(0.99)
+
+
+class Registry:
+    """Process-wide metric store; thread-safe, cardinality-capped."""
+
+    def __init__(self, max_series: int = _DEFAULT_MAX_SERIES) -> None:
         self._lock = threading.Lock()
+        self._max_series = max(int(max_series), 1)
+        self._series = 0
         self._counters: Dict[Tuple[str, TagTuple], int] = defaultdict(int)
         self._gauges: Dict[Tuple[str, TagTuple], float] = {}
-        # timers: (count, total_s, max_s)
-        self._timers: Dict[Tuple[str, TagTuple], Tuple[int, float, float]] = (
-            defaultdict(lambda: (0, 0.0, 0.0))
-        )
+        self._timers: Dict[Tuple[str, TagTuple], Histogram] = {}
+
+    def _admit(self, table, name: str, tags: TagTuple):
+        """Series admission under the lock: an existing key passes; a
+        new key past the cap collapses into the per-name overflow sink
+        and bumps the dropped-writes counter."""
+        key = (name, tags)
+        if key in table:
+            return key
+        if self._series >= self._max_series and tags != OVERFLOW_TAGS:
+            self._counters[(DROPPED_SERIES, ())] += 1
+            # the sink series itself is admitted uncounted: names are
+            # code-bounded, tags are what explode
+            return (name, OVERFLOW_TAGS)
+        if tags != OVERFLOW_TAGS:
+            self._series += 1
+        return key
 
     def inc(self, name: str, tags: TagTuple, delta: int = 1) -> None:
         with self._lock:
-            self._counters[(name, tags)] += delta
+            self._counters[self._admit(self._counters, name, tags)] += delta
 
     def gauge(self, name: str, tags: TagTuple, value: float) -> None:
         with self._lock:
-            self._gauges[(name, tags)] = value
+            self._gauges[self._admit(self._gauges, name, tags)] = value
 
     def record(self, name: str, tags: TagTuple, seconds: float) -> None:
         with self._lock:
-            n, total, mx = self._timers[(name, tags)]
-            self._timers[(name, tags)] = (n + 1, total + seconds, max(mx, seconds))
+            key = self._admit(self._timers, name, tags)
+            hist = self._timers.get(key)
+            if hist is None:
+                hist = self._timers[key] = Histogram()
+            hist.record(seconds)
 
     # -- introspection -------------------------------------------------
 
@@ -56,30 +218,62 @@ class Registry:
 
     def timer_stats(
         self, name: str, tags: Optional[Dict[str, str]] = None
-    ) -> Tuple[int, float, float]:
+    ) -> TimerStats:
+        """Stats for one series (tags given) or the merged distribution
+        across every series of ``name``. Returns a ``TimerStats``:
+        unpacks as the legacy ``(count, total_s, max_s)`` and carries
+        ``p50``/``p95``/``p99``/``avg``/``quantile(q)``."""
+        agg = Histogram()
         with self._lock:
             if tags is not None:
-                return self._timers.get((name, _tags_key(tags)), (0, 0.0, 0.0))
-            agg = (0, 0.0, 0.0)
-            for (n, _), (c, t, m) in self._timers.items():
-                if n == name:
-                    agg = (agg[0] + c, agg[1] + t, max(agg[2], m))
-            return agg
+                hist = self._timers.get((name, _tags_key(tags)))
+                if hist is not None:
+                    agg.merge(hist)
+            else:
+                for (n, _), hist in self._timers.items():
+                    if n == name:
+                        agg.merge(hist)
+        return TimerStats(agg)
+
+    def timer_quantile(
+        self, name: str, q: float, tags: Optional[Dict[str, str]] = None
+    ) -> float:
+        """Histogram-backed quantile in seconds (0.0 when unobserved)."""
+        return self.timer_stats(name, tags).quantile(q)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return self._series
 
     def snapshot(self) -> Dict[str, Dict]:
+        # copy raw state under the lock, then do the expensive part —
+        # per-series quantile walks and key formatting — OUTSIDE it: a
+        # debug snapshot over thousands of series must not stall every
+        # serving thread's inc()/record() on the shared registry
         with self._lock:
-            return {
-                "counters": {
-                    f"{n}{dict(t)}": v for (n, t), v in self._counters.items()
-                },
-                "gauges": {
-                    f"{n}{dict(t)}": v for (n, t), v in self._gauges.items()
-                },
-                "timers": {
-                    f"{n}{dict(t)}": {"count": c, "total_s": ts, "max_s": m}
-                    for (n, t), (c, ts, m) in self._timers.items()
-                },
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers_raw = [
+                (n, t, h.count, h.total, h.max, list(h.counts))
+                for (n, t), h in self._timers.items()
+            ]
+        timers = {}
+        for n, t, count, total, mx, counts in timers_raw:
+            h = Histogram()
+            h.count, h.total, h.max, h.counts = count, total, mx, counts
+            timers[f"{n}{dict(t)}"] = {
+                "count": count, "total_s": total, "max_s": mx,
+                "p50_s": h.quantile(0.50), "p99_s": h.quantile(0.99),
             }
+        return {
+            "counters": {
+                f"{n}{dict(t)}": v for (n, t), v in counters.items()
+            },
+            "gauges": {
+                f"{n}{dict(t)}": v for (n, t), v in gauges.items()
+            },
+            "timers": timers,
+        }
 
 
 class Timer:

@@ -4,10 +4,25 @@ The shape of the reference's scope catalog
 (/root/reference/common/metrics/defs.go — ~2k lines of per-operation
 scope definitions indexed by service): here the catalog is the
 operation lists below, and every listed API gets the standard triple —
-``requests`` counter, ``latency`` timer, ``errors`` counter — recorded
-under tags (service=..., operation=...). ``instrument_methods`` applies
-it mechanically to a handler object's bound methods, mirroring how the
-reference wraps every Thrift handler method in a scoped metrics client.
+``requests`` counter, ``latency`` histogram timer, ``errors`` counter —
+recorded under tags (service=..., operation=...).
+``instrument_methods`` applies it mechanically to a handler object's
+bound methods, mirroring how the reference wraps every Thrift handler
+method in a scoped metrics client; since the telemetry plane landed it
+ALSO opens a child span per call when (and only when) the calling
+thread carries a sampled trace (utils/tracing.py — the unsampled path
+is one thread-local read).
+
+The ``*_METRICS`` tuples below are the operator catalog AND a static
+contract: the analysis pass ``metrics`` (cadence_tpu/analysis/
+metric_decl.py, rule METRIC-UNDECLARED) scans every literal
+``.inc``/``.gauge``/``.record`` emission under runtime/, ops/,
+matching/ and checkpoint/ and fails the lint gate when a name is
+emitted that no catalog declares — the docs here can never silently
+trail the code. Per-tuple coverage tests (tests/test_telemetry.py,
+tests/test_replication_transport.py) additionally prove the inverse
+for the TELEMETRY/DEVICE/REPLICATION families: every declared name is
+really emitted somewhere.
 """
 
 from __future__ import annotations
@@ -16,6 +31,7 @@ import time
 from typing import Iterable
 
 from .metrics import Scope
+from . import tracing as _tracing
 
 # --------------------------------------------------------------------------
 # Scope catalog (reference: common/metrics/defs.go scope enums per service)
@@ -139,6 +155,56 @@ RESHARD_METRICS = (
     "reshard_rollbacks",
 )
 
+# history engine workload counters (runtime/engine/engine.py), tagged
+# (service=history, shard=...): today just the start rate; grows with
+# the serving-path work (METRIC-UNDECLARED keeps this list honest).
+ENGINE_METRICS = ("workflow_started",)
+
+# device-step kernel telemetry (ops/dispatch.py), emitted by the
+# dispatcher per staged/replayed batch under tags (layer=device,
+# kernel=xla|pallas, mode=hist|lanes|hist_assoc|lanes_assoc):
+#
+#   device_batches       counter — batches replayed
+#   host_stage_seconds   histogram — pack + H2D staging wall time
+#   device_step_seconds  histogram — kernel wall time (the run pump
+#                        blocks on the result when telemetry is on, so
+#                        this is honest device time, not dispatch time)
+#   batch_width          histogram — padded batch width per dispatch
+#                        (the compiled-executable grid in action)
+#   padding_frac         gauge — padded slots ÷ real events of the last
+#                        batch (the lane packer's waste)
+#   lane_occupancy       gauge — histories per lane of the last
+#                        lane-packed batch
+#   jit_cache_entries    gauge — total compiled executables across the
+#                        replay kernels visible to this dispatcher
+#   jit_retraces         counter — cache-size growth observed after a
+#                        batch (a retrace storm shows up here first,
+#                        without re-running offline profiles)
+DEVICE_METRICS = (
+    "device_batches",
+    "host_stage_seconds",
+    "device_step_seconds",
+    "batch_width",
+    "padding_frac",
+    "lane_occupancy",
+    "jit_cache_entries",
+    "jit_retraces",
+)
+
+# tracing plane self-telemetry (utils/tracing.py + utils/metrics.py),
+# tagged (layer=telemetry): traces_sampled counts sampled roots,
+# spans_recorded/spans_dropped account the flight-recorder ring buffer
+# (dropped = evicted by capacity before export), and
+# metrics_dropped_series counts emissions the registry's max-series cap
+# collapsed into the overflow sink (a tag-cardinality explosion is
+# observable instead of an OOM).
+TELEMETRY_METRICS = (
+    "traces_sampled",
+    "spans_recorded",
+    "spans_dropped",
+    "metrics_dropped_series",
+)
+
 # the standard per-operation triple
 REQUESTS = "requests"
 LATENCY = "latency"
@@ -157,25 +223,49 @@ def raw_method(fn):
 def instrument_methods(
     obj, scope: Scope, operations: Iterable[str],
 ) -> None:
-    """Wrap each existing bound method in the standard triple. Missing
-    names are skipped so the catalog can list the full API surface
-    while handlers grow into it."""
+    """Wrap each existing bound method in the standard triple plus a
+    trace span. Missing names are skipped so the catalog can list the
+    full API surface while handlers grow into it.
+
+    The span piggybacks on the same mechanical wrapping: when the
+    calling thread carries a sampled trace (utils/tracing.py), the call
+    records a child span named after the operation under the scope's
+    service tag — frontend → history → matching hops all run in the
+    caller's thread, so this single hook links the whole in-process
+    chain. With no active trace, ``TRACER.span`` returns the shared
+    no-op after one thread-local read — the unsampled cost the bench
+    ``telemetry_overhead`` guard pins at ≤3%."""
+    service = getattr(scope, "_tags", {}).get("service", "")
+    tracer = _tracing.TRACER
     for op in operations:
         fn = getattr(obj, op, None)
         if fn is None or not callable(fn):
             continue
         op_scope = scope.tagged(operation=op)
 
-        def wrapped(*args, __fn=fn, __scope=op_scope, **kwargs):
+        def wrapped(*args, __fn=fn, __scope=op_scope, __op=op,
+                    __tls=tracer._tls, **kwargs):
             __scope.inc(REQUESTS)
             t0 = time.perf_counter()
-            try:
-                return __fn(*args, **kwargs)
-            except Exception:
-                __scope.inc(ERRORS)
-                raise
-            finally:
-                __scope.record(LATENCY, time.perf_counter() - t0)
+            if getattr(__tls, "span", None) is None:
+                # unsampled fast path: one thread-local read, no span
+                # machinery at all (the bench telemetry_overhead guard
+                # pins this branch at ≤3% vs the metrics-only wrapper)
+                try:
+                    return __fn(*args, **kwargs)
+                except Exception:
+                    __scope.inc(ERRORS)
+                    raise
+                finally:
+                    __scope.record(LATENCY, time.perf_counter() - t0)
+            with tracer.span(__op, service=service):
+                try:
+                    return __fn(*args, **kwargs)
+                except Exception:
+                    __scope.inc(ERRORS)
+                    raise
+                finally:
+                    __scope.record(LATENCY, time.perf_counter() - t0)
 
         wrapped.__name__ = op
         wrapped.__wrapped__ = fn

@@ -14,6 +14,12 @@ here, all stdlib, no deps:
                                lines — feed straight to flamegraph.pl)
   GET /debug/pprof/heap?topn=N tracemalloc top allocation sites
                                (tracemalloc starts on first call)
+  GET /debug/pprof/traces[?trace_id=ID]
+                               the tracing flight recorder
+                               (utils/tracing.py ring buffer) as
+                               Chrome-trace-format JSON — load in
+                               Perfetto / chrome://tracing; trace_id
+                               filters to one request's trace
   POST /debug/pprof/device/start?dir=D
   POST /debug/pprof/device/stop
                                bracket a jax.profiler trace (XLA/TPU
@@ -99,10 +105,11 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route to our logger, not stderr
         self.server._log.info("pprof " + fmt % args)
 
-    def _reply(self, code: int, body: str) -> None:
+    def _reply(self, code: int, body: str,
+               content_type: str = "text/plain; charset=utf-8") -> None:
         data = body.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -128,6 +135,14 @@ class _Handler(BaseHTTPRequestHandler):
                 ))
             elif path == "/debug/pprof/heap":
                 self._reply(200, heap_top(int(q.get("topn", ["30"])[0])))
+            elif path == "/debug/pprof/traces":
+                from cadence_tpu.utils.tracing import TRACER
+
+                trace_id = q.get("trace_id", [None])[0]
+                self._reply(
+                    200, TRACER.chrome_trace_json(trace_id),
+                    content_type="application/json",
+                )
             else:
                 self._reply(404, f"unknown pprof path {path}\n")
         except Exception as e:  # diagnostics must not kill the server

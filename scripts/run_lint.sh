@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
 # Static-analysis CI gate (cadence_tpu/analysis): transition-surface
-# checker, JIT-hazard lint, lock-order analysis.
+# checker, JIT-hazard lint, lock-order analysis, metric-declaration
+# check (METRIC-UNDECLARED).
 #
 #   scripts/run_lint.sh                    # gate against the baseline
 #   scripts/run_lint.sh --emit-matrix build/transition_matrix.json
 #   scripts/run_lint.sh --passes locks     # one pass only
+#   scripts/run_lint.sh --passes metrics   # metric catalog check only
 #
 # Runs on CPU (the kernel is traced, not executed); non-zero exit on
 # any finding not in config/lint_baseline.json. Tier-1 covers the same

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Trace demo: boot Onebox, run one workflow decision inside a sampled
+# trace, fetch GET /debug/pprof/traces over real HTTP, and pretty-print
+# the Chrome-trace JSON (load it in Perfetto / chrome://tracing).
+#
+#   scripts/run_trace_demo.sh              # full Chrome-trace JSON
+#   scripts/run_trace_demo.sh --summary    # one line per span instead
+#
+# Exits non-zero unless the dumped trace spans frontend → history →
+# matching → queue → persistence with >= 6 linked spans — the same
+# invariant the tier-1 suite asserts (tests/test_telemetry.py), so the
+# endpoint and this script can't rot apart. Smoke-invoked from
+# tests/test_pprof.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+exec python -m cadence_tpu.testing.trace_demo "$@"

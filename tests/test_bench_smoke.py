@@ -120,6 +120,23 @@ def test_smoke_emits_one_json_record():
     assert lag["adaptive"]["mode_switches"] >= 1, lag["adaptive"]
     assert lag["adaptive"]["catch_up_s"] <= \
         lag["events"]["catch_up_s"] * 1.25, lag
+    # the telemetry contract (ISSUE 10): headline latency lines are
+    # Registry.timer_stats-backed histogram p50/p99 (echo — the
+    # serving-shaped config — and rebuild_warm both carry them), and
+    # the unsampled tracing path costs <= 3% vs the metrics-only
+    # wrapper (min over paired interleaved rounds — strictly-additive
+    # timing noise makes every observed ratio an upper bound, so the
+    # guard is stable on loaded CI hosts)
+    for name in ("echo", "rebuild_warm"):
+        cfg = out["configs"][name]
+        assert cfg["latency_p50_ms"] > 0, (name, cfg)
+        assert cfg["latency_p99_ms"] >= cfg["latency_p50_ms"], (name, cfg)
+    tel = out["configs"]["telemetry_overhead"]
+    for key in ("untraced_calls_per_sec", "unsampled_calls_per_sec",
+                "sampled_calls_per_sec", "overhead_unsampled_frac"):
+        assert key in tel, f"telemetry_overhead lacks {key}"
+    assert tel["untraced_calls_per_sec"] > 0
+    assert tel["overhead_unsampled_frac"] <= 0.03, tel
 
 
 def test_watchdog_still_yields_parseable_record():

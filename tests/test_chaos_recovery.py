@@ -1243,6 +1243,64 @@ _RESHARD_WIDS = [f"rs-wf-{i}" for i in range(5)]
 _RESHARD_CLEAN: list = []  # per-process memo: identical workload/driver
 
 
+class TestLinkChaosTracing:
+    """Chaos failures made self-explaining (ISSUE 10): a sampled trace
+    from a geo run under deterministic write faults records the fault
+    injections as span annotations (testing/faults.py annotates the
+    active span), so the trace shows where the faults landed next to
+    the work they interrupted."""
+
+    def test_sampled_trace_records_fault_annotations(self):
+        from cadence_tpu.runtime.api import SignalRequest
+        from cadence_tpu.utils.tracing import TRACER
+
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.execution",
+                      method="update_workflow_execution",
+                      probability=1.0, max_faults=2,
+                      error="ConditionFailedError"),
+        ])
+        TRACER.configure(sample_rate=0.0)
+        TRACER.clear()
+        box = GeoChaosBox(faults=sched)
+        try:
+            with TRACER.trace("geo_chaos_run", sampled=True) as root:
+                trace_id = root.trace_id
+                box.frontend.start_workflow_execution(
+                    StartWorkflowRequest(
+                        domain=DOMAIN, workflow_id="geo-trace-0",
+                        workflow_type="chaos-wf",
+                        task_list="geo-trace-tl", input=b"x",
+                        request_id="req-geo-trace-0",
+                        execution_start_to_close_timeout_seconds=300,
+                    )
+                )
+                for k in range(3):
+                    box.frontend.signal_workflow_execution(SignalRequest(
+                        domain=DOMAIN, workflow_id="geo-trace-0",
+                        signal_name=f"s{k}", input=b"x",
+                        identity="geo-trace",
+                    ))
+        finally:
+            box.stop()
+        spans = [s for s in TRACER.spans() if s.trace_id == trace_id]
+        TRACER.clear()
+        assert sched.injected_total() == 2, sched.snapshot()
+        annotations = [a for s in spans for _, a in s.annotations]
+        faults_seen = [a for a in annotations if "fault_injected" in a]
+        assert len(faults_seen) == 2, annotations
+        assert all(
+            "site=persistence.execution" in a for a in faults_seen
+        )
+        # the interrupted persistence calls are error-tagged spans in
+        # the SAME trace — failure and cause sit side by side
+        errored = [
+            s for s in spans
+            if s.tags.get("error") == "ConditionFailedError"
+        ]
+        assert errored, [s.name for s in spans]
+
+
 class TestReshardChaos:
     """The ROADMAP's reshard scenario family: split/merge executed
     mid-traffic under ≥10% injected write faults, host kill
@@ -1262,6 +1320,55 @@ class TestReshardChaos:
             finally:
                 box.stop()
         return list(_RESHARD_CLEAN)
+
+    def test_sampled_trace_records_ownership_retry_spans(self):
+        """The reshard failure shape made self-explaining: an
+        ownership-lost write fault surfaces as a ``retry.*`` span in
+        the sampled trace (client/history.py re-resolution) with the
+        injection annotated at the persistence span that raised — a
+        mid-handoff trace reads as fault → error → retry → success
+        without log correlation."""
+        from cadence_tpu.utils.tracing import TRACER
+
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.execution",
+                      method="create_workflow_execution",
+                      probability=1.0, max_faults=1,
+                      error="ShardOwnershipLostError"),
+        ])
+        TRACER.configure(sample_rate=0.0)
+        TRACER.clear()
+        box = ChaosBox(faults=sched, num_shards=1)
+        try:
+            with TRACER.trace("reshard_chaos_run", sampled=True) as root:
+                trace_id = root.trace_id
+                box.frontend.start_workflow_execution(
+                    StartWorkflowRequest(
+                        domain=DOMAIN, workflow_id="trace-retry-0",
+                        workflow_type="chaos-wf", task_list=TL,
+                        input=b"x", request_id="req-trace-retry-0",
+                        execution_start_to_close_timeout_seconds=60,
+                    )
+                )
+        finally:
+            box.stop()
+        spans = [s for s in TRACER.spans() if s.trace_id == trace_id]
+        TRACER.clear()
+        assert sched.injected_total() == 1, sched.snapshot()
+        retry_spans = [
+            s for s in spans if s.name.startswith("retry.")
+        ]
+        assert retry_spans, [s.name for s in spans]
+        assert retry_spans[0].name == "retry.start_workflow_execution"
+        assert retry_spans[0].tags.get("error") is None  # it succeeded
+        assert any(
+            "ownership_lost" in a
+            for _, a in retry_spans[0].annotations
+        )
+        annotations = [a for s in spans for _, a in s.annotations]
+        assert any("fault_injected" in a for a in annotations), (
+            annotations
+        )
 
     def test_split_then_merge_under_write_faults_byte_identical(self):
         """A split AND a merge executed while the doubler workload runs

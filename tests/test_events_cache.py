@@ -158,11 +158,15 @@ class TestScopedMetrics:
         hist.start()
         try:
             engine = hist.controller.get_engine_for_shard(0)
-            before = NOOP.registry.counter_value(
-                "requests",
-                {"service": "history", "shard": "0",
-                 "operation": "start_workflow_execution"},
-            )
+            # the per-op triple lands in the SERVICE's registry (the
+            # engine ctor receives the scope; a post-construction
+            # metrics assignment used to strand every history API
+            # latency in the shared NOOP registry), and the new
+            # histogram timers back real percentiles
+            tags = {"service": "history", "shard": "0",
+                    "operation": "start_workflow_execution"}
+            reg = hist.metrics.registry
+            assert reg.counter_value("requests", tags) == 0
             engine.start_workflow_execution(
                 StartWorkflowRequest(
                     domain="m-dom", workflow_id="m-wf", workflow_type="t",
@@ -170,12 +174,9 @@ class TestScopedMetrics:
                     execution_start_to_close_timeout_seconds=60,
                 ),
             )
-            after = NOOP.registry.counter_value(
-                "requests",
-                {"service": "history", "shard": "0",
-                 "operation": "start_workflow_execution"},
-            )
-            assert after == before + 1
+            assert reg.counter_value("requests", tags) == 1
+            lat = reg.timer_stats("latency", tags)
+            assert lat.count == 1 and lat.p99 >= lat.p50 > 0
         finally:
             hist.stop()
             matching.shutdown()

@@ -42,6 +42,69 @@ def test_endpoints_serve():
         srv.stop()
 
 
+def test_traces_endpoint_serves_flight_recorder():
+    """GET /debug/pprof/traces returns the tracing flight recorder as
+    Chrome-trace JSON, filterable by trace_id (utils/tracing.py)."""
+    import json
+
+    from cadence_tpu.utils.tracing import TRACER
+
+    TRACER.clear()
+    srv = PProfServer().start()
+    try:
+        with TRACER.trace("probe", sampled=True,
+                          service="pprof-test") as root:
+            TRACER.annotate("note")
+            trace_id = root.trace_id
+        status, body = _get(srv.address, "/debug/pprof/traces")
+        assert status == 200
+        doc = json.loads(body)
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert any(e["name"] == "probe" for e in spans)
+        status, body = _get(
+            srv.address, f"/debug/pprof/traces?trace_id={trace_id}"
+        )
+        doc = json.loads(body)
+        assert [
+            e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+        ] == ["probe"]
+        status, body = _get(
+            srv.address, "/debug/pprof/traces?trace_id=nope"
+        )
+        assert json.loads(body)["traceEvents"] == []
+    finally:
+        srv.stop()
+        TRACER.clear()
+
+
+def test_trace_demo_script_smoke():
+    """scripts/run_trace_demo.sh boots Onebox, runs one workflow, and
+    dumps a frontend→history→matching→queue→persistence trace through
+    the HTTP endpoint — invoked for real so the endpoint, the demo and
+    the script can't rot apart."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "cadence_tpu.testing.trace_demo",
+         "--quiet"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=180,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) >= 6
+    services = {
+        m["args"]["name"] for m in doc["traceEvents"] if m["ph"] == "M"
+    }
+    assert {"frontend", "history", "matching", "history_queue",
+            "persistence"} <= services
+
+
 def test_cpu_sampler_catches_hot_function():
     stop = threading.Event()
 

@@ -801,6 +801,87 @@ class TestLockOrder:
 # --------------------------------------------------------------------------
 
 
+class TestMetricDecl:
+    """Known-bad fixtures for pass 4 (METRIC-UNDECLARED): literal
+    metric emissions must appear in a utils/metrics_defs.py catalog."""
+
+    def _scan(self, src):
+        from cadence_tpu.analysis import metric_decl
+
+        return metric_decl.scan_source(
+            textwrap.dedent(src), "fixture/mod.py",
+            metric_decl.declared_names(),
+        )
+
+    def test_undeclared_literal_fires(self):
+        fs = self._scan("""
+            def emit(scope):
+                scope.inc("totally_undocumented_counter")
+        """)
+        assert [f.rule for f in fs] == ["METRIC-UNDECLARED"]
+        assert fs[0].anchor == (
+            "fixture/mod.py:totally_undocumented_counter"
+        )
+
+    def test_all_emit_methods_covered(self):
+        fs = self._scan("""
+            def emit(scope):
+                scope.inc("mystery_a")
+                scope.gauge("mystery_b", 1.0)
+                scope.record("mystery_c", 0.5)
+        """)
+        assert {f.anchor.split(":")[1] for f in fs} == {
+            "mystery_a", "mystery_b", "mystery_c"
+        }
+
+    def test_declared_names_pass(self):
+        fs = self._scan("""
+            def emit(scope):
+                scope.inc("task_requests")
+                scope.gauge("replication_lag_events", 3)
+                scope.record("device_step_seconds", 0.1)
+                scope.inc("requests")
+        """)
+        assert fs == []
+
+    def test_dynamic_names_skipped(self):
+        # f-strings and variables are outside the catalog contract
+        # (the persistence decorator's per-API family)
+        fs = self._scan("""
+            def emit(scope, name):
+                scope.inc(f"{name}.errors")
+                scope.record(name, 0.1)
+                scope.gauge(name + "_depth", 1)
+        """)
+        assert fs == []
+
+    def test_unparseable_source_fails_loudly(self):
+        fs = self._scan("def broken(:")
+        assert [f.rule for f in fs] == ["METRIC-UNDECLARED"]
+        assert "unparseable" in fs[0].message
+
+    def test_catalog_union_includes_every_tuple(self):
+        from cadence_tpu.analysis.metric_decl import declared_names
+        from cadence_tpu.utils import metrics_defs as defs
+
+        names = declared_names()
+        for tup in (defs.QUEUE_METRICS, defs.REPLICATION_METRICS,
+                    defs.CHECKPOINT_METRICS, defs.RESHARD_METRICS,
+                    defs.DEVICE_METRICS, defs.TELEMETRY_METRICS,
+                    defs.ENGINE_METRICS, defs.FAULT_METRICS):
+            assert set(tup) <= names
+
+    def test_pass_registered_in_run_all(self):
+        from cadence_tpu.analysis import PASSES
+
+        assert "metrics" in PASSES
+
+    def test_real_tree_scan_is_clean(self):
+        from cadence_tpu.analysis import metric_decl
+
+        assert metric_decl.run(REPO_ROOT) == []
+
+
 class TestCleanTreeGate:
     def test_zero_new_findings(self):
         baseline = Baseline.load(

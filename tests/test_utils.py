@@ -167,6 +167,82 @@ def test_metrics_scope():
     assert count == 1 and total >= 0
 
 
+def test_timer_histogram_quantiles():
+    # fixed-boundary exponential buckets: p50/p99 land in the right
+    # decade and interpolate inside the winning bucket, clamped to the
+    # observed max (utils/metrics.py Histogram)
+    s = Scope()
+    for v in [0.001] * 90 + [0.1] * 10:
+        s.record("lat", v)
+    st = s.registry.timer_stats("lat")
+    assert st.count == 100
+    assert 0.0005 <= st.p50 <= 0.0011
+    assert 0.05 <= st.p99 <= 0.1
+    assert st.quantile(1.0) == st.max_s == 0.1
+    assert abs(st.avg - (0.09 * 0.001 + 0.01 * 0.1) * 10) < 1e-9
+    # legacy 3-tuple unpacking stays source-compatible
+    count, total, mx = st
+    assert (count, mx) == (100, 0.1)
+    assert st.total_s == total
+    # empty series: zeros, not errors
+    empty = s.registry.timer_stats("never")
+    assert tuple(empty) == (0, 0.0, 0.0) and empty.p99 == 0.0
+    # quantile helper + snapshot carry the percentiles
+    assert s.registry.timer_quantile("lat", 0.5) == st.p50
+    snap_timers = s.registry.snapshot()["timers"]
+    (entry,) = [v for k, v in snap_timers.items() if "lat" in k]
+    assert entry["p50_s"] == st.p50 and entry["p99_s"] == st.p99
+
+
+def test_timer_histogram_power_of_two_boundaries():
+    # bounds are (2^(i-1), 2^i] upper-INCLUSIVE: an exact power-of-two
+    # sample belongs to the lower bucket (frexp returns m=0.5 there; a
+    # prior off-by-one inflated interpolated medians ~47%)
+    from cadence_tpu.utils.metrics import Histogram, _bucket_index
+
+    assert _bucket_index(1e-6) == 0
+    assert _bucket_index(2e-6) == 1   # not 2
+    assert _bucket_index(2.1e-6) == 2
+    assert _bucket_index(4e-6) == 2
+    h = Histogram()
+    for v in (2e-6, 2e-6, 3.9e-6):
+        h.record(v)
+    assert h.quantile(0.5) <= 2e-6 + 1e-12
+
+
+def test_timer_histogram_merges_across_tags():
+    s = Scope()
+    s.tagged(shard="0").record("lat", 0.001)
+    s.tagged(shard="1").record("lat", 0.004)
+    merged = s.registry.timer_stats("lat")
+    assert merged.count == 2 and merged.max_s == 0.004
+    only = s.registry.timer_stats("lat", {"shard": "1"})
+    assert only.count == 1 and only.p99 <= 0.004
+
+
+def test_registry_series_cap_overflow_sink():
+    # a tag-cardinality explosion collapses into the overflow sink and
+    # is counted, instead of growing the maps unboundedly
+    from cadence_tpu.utils.metrics import Registry
+
+    reg = Registry(max_series=4)
+    scope = Scope(reg)
+    for i in range(50):
+        scope.tagged(wf=str(i)).inc("runaway")
+        scope.tagged(wf=str(i)).record("runaway_lat", 0.001)
+    assert reg.series_count() == 4
+    dropped = reg.counter_value("metrics_dropped_series")
+    assert dropped > 0
+    # the suppressed writes are still observable, attributed to the sink
+    assert reg.counter_value("runaway", {"overflow": "true"}) > 0
+    assert reg.timer_stats(
+        "runaway_lat", {"overflow": "true"}
+    ).count > 0
+    # existing series keep recording normally past the cap
+    scope.tagged(wf="0").inc("runaway")
+    assert reg.counter_value("runaway", {"wf": "0"}) == 2
+
+
 def test_token_bucket():
     t = [0.0]
     tb = TokenBucket(10, burst=2, clock=lambda: t[0])
