@@ -1,6 +1,6 @@
 """Static-analysis CI gate for the cadence-tpu kernel/runtime contract.
 
-Three passes, run together by ``python -m cadence_tpu.analysis``:
+Five passes, run together by ``python -m cadence_tpu.analysis``:
 
 1. **transition surface** (transition_surface.py) — the kernel's
    event-type × column write matrix, traced at jaxpr level, diffed
@@ -17,6 +17,13 @@ Three passes, run together by ``python -m cadence_tpu.analysis``:
    runtime/ops/matching/checkpoint must be declared in a
    utils/metrics_defs.py catalog (rule METRIC-UNDECLARED): the
    operator docs can never silently trail the code.
+5. **queue effects** (queue_effects.py) — AST-derived effect
+   footprints of every queue-task handler (transfer/timer/standby +
+   the NDC apply path) diffed against the declared footprint table
+   (runtime/queues/effects.py): rules QUEUE-EFFECT-UNKNOWN,
+   QUEUE-CONFLICT-UNDECLARED, QUEUE-CROSS-WF. The footprints derive
+   the task-type commutativity matrix (--emit-conflict-matrix) the
+   future parallel-queue executor gates on.
 
 Findings gate against a checked-in baseline
 (config/lint_baseline.json): accepted findings carry a one-line
@@ -30,7 +37,32 @@ from typing import Dict, List, Optional
 
 from .findings import Baseline, BaselineEntry, Finding, dedupe
 
-PASSES = ("surface", "jit", "locks", "metrics")
+PASSES = ("surface", "jit", "locks", "metrics", "queue")
+
+# rule-id prefixes per pass — lets a --passes subset run scope the
+# baseline to the rules that could actually fire, so entries belonging
+# to skipped passes are not reported (or strict-failed) as stale
+PASS_RULE_PREFIXES = {
+    "surface": ("SURFACE-", "SCHEMA-", "ASSOC-"),
+    "jit": ("JIT-", "PALLAS-"),
+    "locks": ("LOCK-",),
+    "metrics": ("METRIC-",),
+    "queue": ("QUEUE-",),
+}
+
+
+def scope_baseline(baseline, passes):
+    """Baseline restricted to entries whose rule belongs to ``passes``
+    (None = all passes, returned unchanged). Entries with rules outside
+    every known prefix only gate on full runs."""
+    if passes is None:
+        return baseline
+    prefixes = tuple(
+        p for name in passes for p in PASS_RULE_PREFIXES.get(name, ())
+    )
+    return Baseline([
+        e for e in baseline.entries if e.rule.startswith(prefixes)
+    ]) if prefixes else Baseline([])
 
 
 def run_pass(name: str, repo_root: str) -> List[Finding]:
@@ -50,6 +82,10 @@ def run_pass(name: str, repo_root: str) -> List[Finding]:
         from . import metric_decl
 
         return metric_decl.run(repo_root)
+    if name == "queue":
+        from . import queue_effects
+
+        return queue_effects.run(repo_root)
     raise ValueError(f"unknown pass {name!r} (have: {PASSES})")
 
 
@@ -65,5 +101,6 @@ def run_all(
 
 __all__ = [
     "Baseline", "BaselineEntry", "Finding", "PASSES",
-    "dedupe", "run_all", "run_pass",
+    "PASS_RULE_PREFIXES", "dedupe", "run_all", "run_pass",
+    "scope_baseline",
 ]

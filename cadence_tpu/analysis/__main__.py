@@ -1,8 +1,10 @@
 """CLI for the static-analysis gate.
 
     python -m cadence_tpu.analysis [--baseline config/lint_baseline.json]
-                                   [--passes surface,jit,locks,metrics]
+                                   [--passes surface,jit,locks,metrics,queue]
                                    [--emit-matrix PATH]
+                                   [--emit-conflict-matrix PATH]
+                                   [--strict-stale]
                                    [--write-baseline PATH]
                                    [--root DIR]
 
@@ -35,11 +37,22 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--passes", default=None,
-        help="comma-separated subset of passes (surface,jit,locks,metrics)",
+        help="comma-separated subset of passes "
+        "(surface,jit,locks,metrics,queue)",
     )
     ap.add_argument(
         "--emit-matrix", default=None, metavar="PATH",
         help="also write the transition coverage matrix JSON artifact",
+    )
+    ap.add_argument(
+        "--emit-conflict-matrix", default=None, metavar="PATH",
+        help="also write the queue-task commutativity matrix JSON "
+        "artifact (the parallel-queue executor's gate)",
+    )
+    ap.add_argument(
+        "--strict-stale", action="store_true",
+        help="treat stale baseline entries as errors (exit 1) instead "
+        "of warnings, so dead entries can't accumulate silently",
     )
     ap.add_argument(
         "--write-baseline", default=None, metavar="PATH",
@@ -52,7 +65,7 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
-    from . import Baseline, BaselineEntry, run_all
+    from . import Baseline, BaselineEntry, run_all, scope_baseline
 
     passes = args.passes.split(",") if args.passes else None
     t0 = time.monotonic()
@@ -75,6 +88,22 @@ def main(argv=None) -> int:
             return 2
         print(f"transition matrix -> {args.emit_matrix}")
 
+    if args.emit_conflict_matrix:
+        from . import queue_effects
+
+        try:
+            queue_effects.emit_conflict_matrix(
+                args.root, args.emit_conflict_matrix
+            )
+        except Exception as e:
+            print(
+                f"analysis error writing conflict matrix: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        print(f"queue conflict matrix -> {args.emit_conflict_matrix}")
+
     all_findings = [f for fs in by_pass.values() for f in fs]
 
     if args.write_baseline:
@@ -90,6 +119,10 @@ def main(argv=None) -> int:
     baseline = Baseline()
     if args.baseline:
         baseline = Baseline.load(args.baseline)
+    # a --passes subset must not count the skipped passes' baseline
+    # entries as stale (a `--passes queue` run would otherwise strict-
+    # fail on every SURFACE-*/LOCK-* entry)
+    baseline = scope_baseline(baseline, passes)
     new, accepted, stale = baseline.split(all_findings)
 
     for name, fs in by_pass.items():
@@ -99,8 +132,9 @@ def main(argv=None) -> int:
                   f"{len(fs) - len(fresh)} baselined ==")
         for f in fresh:
             print(f.format())
+    stale_word = "error" if args.strict_stale else "warning"
     for e in stale:
-        print(f"warning: stale baseline entry [{e.rule}] {e.anchor} "
+        print(f"{stale_word}: stale baseline entry [{e.rule}] {e.anchor} "
               "matched nothing (fixed? remove it)", file=sys.stderr)
 
     dt = time.monotonic() - t0
@@ -109,7 +143,11 @@ def main(argv=None) -> int:
         f"{len(accepted)} baselined, {len(new)} new, "
         f"{len(stale)} stale baseline entr(ies) in {dt:.1f}s"
     )
-    return 1 if new else 0
+    if new:
+        return 1
+    if stale and args.strict_stale:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -465,6 +465,13 @@ def collect_findings(classes: List[ClassAnalysis]) -> List[Finding]:
 SCOPE_DIRS = ("cadence_tpu/runtime", "cadence_tpu/checkpoint",
               "cadence_tpu/matching")
 
+# single files outside the scanned packages that grew locks (PR 9's
+# telemetry plane: the flight-recorder ring and the registry series
+# map) — every serving thread passes through these under load, so
+# their locks belong in the same inventory/inversion proof
+SCOPE_FILES = ("cadence_tpu/utils/tracing.py",
+               "cadence_tpu/utils/metrics.py")
+
 
 def run(repo_root: str) -> List[Finding]:
     classes: List[ClassAnalysis] = []
@@ -480,4 +487,9 @@ def run(repo_root: str) -> List[Finding]:
                 rel = os.path.relpath(fpath, repo_root)
                 with open(fpath) as f:
                     classes += analyze_module(f.read(), rel)
+    for rel in SCOPE_FILES:
+        fpath = os.path.join(repo_root, rel)
+        if os.path.isfile(fpath):
+            with open(fpath) as f:
+                classes += analyze_module(f.read(), rel)
     return collect_findings(classes)

@@ -27,7 +27,6 @@ sample that surface; this pass covers it statically:
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
@@ -688,12 +687,14 @@ def run(repo_root: str) -> List[Finding]:
 
 
 def emit_matrix(repo_root: str, path: str) -> None:
-    """Write the transition coverage matrix as a JSON build artifact."""
+    """Write the transition coverage matrix as a JSON build artifact
+    (versioned via the shared artifact envelope, like the queue
+    conflict matrix — downstream consumers validate schema_version +
+    kind instead of guessing from the file name)."""
     from cadence_tpu.core.enums import EventType
 
-    parent = os.path.dirname(path)
-    if parent:
-        os.makedirs(parent, exist_ok=True)
+    from .artifact import write_artifact
+
     kmat, otable, pack_handled, rel_ts = build(repo_root)
     doc = {
         "common": sorted(kmat.common),
@@ -721,6 +722,4 @@ def emit_matrix(repo_root: str, path: str) -> None:
             for tname, e in sorted(otable.items())
         },
     }
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=2)
-        f.write("\n")
+    write_artifact(path, "transition_matrix", doc)

@@ -101,7 +101,7 @@ class RateLimitedClient(_Wrapped):
 
 def wrap_bundle(bundle, metrics: Scope = NOOP,
                 max_qps: Optional[float] = None,
-                faults=None):
+                faults=None, effects=False):
     """Layer metrics (and optionally rate limits) over every manager in
     a PersistenceBundle, mirroring persistence-factory/factory.go.
 
@@ -111,6 +111,12 @@ def wrap_bundle(bundle, metrics: Scope = NOOP,
     the rate limiter, so an injected PersistenceBusyError surfaces to
     the caller untranslated. Nothing is installed when it is None: the
     default factory stack pays zero overhead for the chaos machinery.
+
+    ``effects=True`` installs the effect-witness recording client
+    (testing/effect_witness.py) BELOW the fault client — the witness
+    must see the real store calls, so an injected error that never
+    reached the backend is not recorded while a torn write that landed
+    is. Testing-only, like ``faults``.
     """
     from .interfaces import PersistenceBundle
 
@@ -121,11 +127,20 @@ def wrap_bundle(bundle, metrics: Scope = NOOP,
         from cadence_tpu.testing.faults import FaultInjectionClient
 
         fault_client = FaultInjectionClient
+    effect_client = None
+    if effects:
+        from cadence_tpu.testing.effect_witness import (
+            EffectRecordingClient,
+        )
+
+        effect_client = EffectRecordingClient
 
     def deco(mgr, name):
         if mgr is None:
             return None
         out = mgr
+        if effect_client is not None:
+            out = effect_client(out, manager=name)
         if fault_client is not None:
             out = fault_client(out, faults, manager=name)
         out = MetricsClient(out, metrics, manager=name)

@@ -13,6 +13,7 @@ and retries work items.
 
 from .ack import QueueAckManager
 from .base import QueueProcessorBase
+from .effects import Footprint, TASK_FOOTPRINTS, build_conflict_matrix
 from .standby import (
     QueueGC,
     TimerQueueStandbyProcessor,
@@ -23,9 +24,12 @@ from .timer_gate import LocalTimerGate, RemoteTimerGate
 from .transfer import TransferQueueProcessor
 
 __all__ = [
+    "Footprint",
     "QueueAckManager",
     "QueueGC",
     "QueueProcessorBase",
+    "TASK_FOOTPRINTS",
+    "build_conflict_matrix",
     "TimerQueueProcessor",
     "TimerQueueStandbyProcessor",
     "LocalTimerGate",

@@ -23,6 +23,7 @@ from cadence_tpu.utils.tracing import NOOP_SPAN, TRACER
 
 from .ack import QueueAckManager
 from .allocator import DeferTask, defer_task
+from .effects import task_effect_scope
 
 _TASK_RETRY_COUNT = 3
 
@@ -144,7 +145,11 @@ def run_task_attempts(
         try:
             if fault_hook is not None:
                 fault_hook(str(getattr(task, "task_type", "")))
-            process(task)
+            # attribute persistence calls to this task for the effect
+            # witness (testing/effect_witness.py); zero-cost when no
+            # recorder is installed
+            with task_effect_scope(name, getattr(task, "task_type", "")):
+                process(task)
             return True
         except DeferTask:
             defer_task(ack, key)

@@ -122,8 +122,10 @@ class NDCHistoryReplicator:
         # workflow-keyed binding the queue pumps use joins this apply to
         # the workflow's sampled trace, if one exists (utils/tracing.py)
         from cadence_tpu.runtime.queues.base import task_span
+        from cadence_tpu.runtime.queues.effects import task_effect_scope
 
-        with task_span("replication-apply", task):
+        with task_span("replication-apply", task), \
+                task_effect_scope("replication", "HistoryReplication"):
             ctx = self.cache.get_or_create(
                 task.domain_id, task.workflow_id, task.run_id
             )
@@ -167,6 +169,14 @@ class NDCHistoryReplicator:
                 barrier[wf_key] = key
         if not deferred:
             return
+        from cadence_tpu.runtime.queues.effects import task_effect_scope
+
+        # the batched conflict rebuilds happen outside apply_events;
+        # they are still HistoryReplication work for the effect witness
+        with task_effect_scope("replication", "HistoryReplication"):
+            self._drain_deferred(deferred, order)
+
+    def _drain_deferred(self, deferred: dict, order: list) -> None:
         reqs = [
             RebuildRequest(
                 domain_id=deferred[k]["task"].domain_id,
@@ -587,8 +597,11 @@ class NDCHistoryReplicator:
             self._fault_hook("apply_state_snapshot", self.shard.shard_id)
         snap_tip = int(ckpt.event_id)
         snap_version = int(ckpt.vh_items[-1][1])
+        from cadence_tpu.runtime.queues.effects import task_effect_scope
+
+        witness = task_effect_scope("replication", "SnapshotReplication")
         ctx = self.cache.get_or_create(domain_id, workflow_id, run_id)
-        with ctx.lock:
+        with witness, ctx.lock:
             try:
                 ms = ctx.load()
             except EntityNotExistsError:
@@ -706,8 +719,11 @@ class NDCHistoryReplicator:
         batches = [b for b in batches if b]
         if not batches:
             return 0
+        from cadence_tpu.runtime.queues.effects import task_effect_scope
+
+        witness = task_effect_scope("replication", "HistoryBackfill")
         ctx = self.cache.get_or_create(domain_id, workflow_id, run_id)
-        with ctx.lock:
+        with witness, ctx.lock:
             ms = ctx.load()
             branch = BranchToken.from_json(
                 ms.execution_info.branch_token.decode()

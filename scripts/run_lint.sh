@@ -1,21 +1,30 @@
 #!/usr/bin/env bash
 # Static-analysis CI gate (cadence_tpu/analysis): transition-surface
 # checker, JIT-hazard lint, lock-order analysis, metric-declaration
-# check (METRIC-UNDECLARED).
+# check (METRIC-UNDECLARED), queue-effect analysis (Pass 5:
+# QUEUE-EFFECT-UNKNOWN / QUEUE-CONFLICT-UNDECLARED / QUEUE-CROSS-WF).
 #
 #   scripts/run_lint.sh                    # gate against the baseline
 #   scripts/run_lint.sh --emit-matrix build/transition_matrix.json
 #   scripts/run_lint.sh --passes locks     # one pass only
-#   scripts/run_lint.sh --passes metrics   # metric catalog check only
+#   scripts/run_lint.sh --passes queue     # queue-effect pass only
 #
 # Runs on CPU (the kernel is traced, not executed); non-zero exit on
-# any finding not in config/lint_baseline.json. Tier-1 covers the same
-# gate in-process via tests/test_static_analysis.py; this wrapper is
-# the standalone/CI entry.
+# any finding not in config/lint_baseline.json, and — via
+# --strict-stale — on any baseline entry matching nothing, so dead
+# entries can't accumulate silently. Also emits the queue-task
+# commutativity matrix artifact (the parallel-queue executor's gate)
+# under build/, versioned via the shared schema_version envelope.
+# Tier-1 covers the same gate in-process via
+# tests/test_static_analysis.py; this wrapper is the standalone/CI
+# entry.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
 exec python -m cadence_tpu.analysis \
-    --baseline config/lint_baseline.json "$@"
+    --baseline config/lint_baseline.json \
+    --strict-stale \
+    --emit-conflict-matrix build/queue_conflict_matrix.json \
+    "$@"

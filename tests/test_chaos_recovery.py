@@ -81,14 +81,15 @@ class ChaosBox:
     monitor whose history ring lists every host (the reshard chaos
     family kills hosts mid-handoff)."""
 
-    def __init__(self, faults=None, num_shards=1, hosts=1):
+    def __init__(self, faults=None, num_shards=1, hosts=1, effects=False):
         from cadence_tpu.runtime.membership import Monitor
 
         self.metrics = Scope()
         self.persistence = create_memory_bundle()
-        if faults is not None:
+        if faults is not None or effects:
             self.persistence = wrap_bundle(
-                self.persistence, metrics=self.metrics, faults=faults
+                self.persistence, metrics=self.metrics, faults=faults,
+                effects=effects,
             )
         self.domain_handler = DomainHandler(
             self.persistence.metadata, ClusterMetadata()
@@ -473,6 +474,111 @@ class TestDecoratorStack:
         assert type(bundle.metadata._base).__name__ == (
             "MemoryMetadataManager"
         )
+
+
+# ---------------------------------------------------------------------------
+# queue-task effect witness (the dynamic half of analysis Pass 5)
+# ---------------------------------------------------------------------------
+
+
+class TestEffectWitness:
+    """Static/dynamic bidirectional proof for the queue-effect
+    footprints: Pass 5 proves AST-extracted ⊆ declared; this suite
+    proves RECORDED ⊆ extracted under the ≥10% write-fault storm — the
+    conflict matrix the parallel queue will trust is validated under
+    execution, retries and torn writes included, not just by AST
+    reading."""
+
+    def _drive_with_recorder(self, faults=None):
+        from cadence_tpu.testing.effect_witness import EffectRecorder
+
+        rec = EffectRecorder().install()
+        try:
+            box = ChaosBox(faults=faults, effects=True)
+            try:
+                _drive_workflows(box, ["wf-1", "wf-2"])
+                # the CloseExecution fan-out runs async after the
+                # workflow completes: wait for the witness to see it
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if ("transfer", "CloseExecution") in rec.snapshot():
+                        break
+                    time.sleep(0.02)
+            finally:
+                box.stop()
+        finally:
+            rec.uninstall()
+        return rec
+
+    def test_recorded_effects_within_static_footprints(self):
+        """Witness under the write-fault storm: every persistence call
+        recorded during task execution must land inside BOTH the
+        declared footprint table and the AST-extracted footprints (the
+        stronger direction — it validates the extractor itself)."""
+        from cadence_tpu.analysis import queue_effects
+        from cadence_tpu.testing.effect_witness import check_witness
+
+        sched = _write_fault_schedule(CHAOS_SEED)
+        rec = self._drive_with_recorder(faults=sched)
+
+        snap = rec.snapshot()
+        assert snap, "witness recorded nothing — task scope wiring broken"
+        assert ("transfer", "CloseExecution") in snap, snap
+        # the storm actually hit (same floor as the differential suite)
+        assert sched.injected_total() > 0, sched.snapshot()
+
+        assert check_witness(rec) == []  # recorded ⊆ declared
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__
+        )))
+        extracted = {
+            k: fp
+            for k, (_, _, fp) in
+            queue_effects.handler_footprints(repo_root).items()
+            if fp is not None
+        }
+        assert check_witness(rec, extracted) == []  # recorded ⊆ static
+
+    def test_witness_catches_escaping_effect(self):
+        """The checker is falsifiable: a recorded write outside the
+        footprint must surface as a violation (a witness that can't
+        fail proves nothing)."""
+        from cadence_tpu.testing.effect_witness import (
+            EffectRecorder,
+            check_witness,
+        )
+
+        rec = EffectRecorder()
+        rec.record("transfer", "DecisionTask", "visibility",
+                   "upsert_workflow_execution")
+        violations = check_witness(rec)
+        assert violations and "visibility" in violations[0], violations
+
+    def test_scope_attribution_drops_unscoped_calls(self):
+        """Persistence calls outside any task scope (pump machinery,
+        setup) must not be attributed to a task."""
+        from cadence_tpu.runtime.queues.effects import (
+            record_persistence_call,
+            set_recorder,
+            task_effect_scope,
+        )
+
+        seen = []
+        set_recorder(lambda *a: seen.append(a))
+        try:
+            record_persistence_call("execution", "get_transfer_tasks")
+            assert seen == []
+            with task_effect_scope("transfer-7", 0):
+                record_persistence_call(
+                    "execution", "update_workflow_execution"
+                )
+            record_persistence_call("shard", "update_shard")
+        finally:
+            set_recorder(None)
+        assert seen == [
+            ("transfer", "DecisionTask", "execution",
+             "update_workflow_execution")
+        ]
 
 
 # ---------------------------------------------------------------------------
