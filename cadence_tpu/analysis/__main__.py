@@ -4,6 +4,7 @@
                                    [--passes surface,jit,locks,metrics,queue]
                                    [--emit-matrix PATH]
                                    [--emit-conflict-matrix PATH]
+                                   [--emit-lock-graph PATH [--witness PATH]]
                                    [--strict-stale]
                                    [--write-baseline PATH]
                                    [--root DIR]
@@ -48,6 +49,18 @@ def main(argv=None) -> int:
         "--emit-conflict-matrix", default=None, metavar="PATH",
         help="also write the queue-task commutativity matrix JSON "
         "artifact (the parallel-queue executor's gate)",
+    )
+    ap.add_argument(
+        "--emit-lock-graph", default=None, metavar="PATH",
+        help="also write the lock-graph JSON artifact: static lock "
+        "inventory + acquisition-order edges, annotated "
+        "observed/never-observed against the latest runtime witness "
+        "(build/lock_witness.json from a sanitized suite run)",
+    )
+    ap.add_argument(
+        "--witness", default=None, metavar="PATH",
+        help="runtime lock-witness artifact for --emit-lock-graph "
+        "annotations (default: build/lock_witness.json under --root)",
     )
     ap.add_argument(
         "--strict-stale", action="store_true",
@@ -103,6 +116,27 @@ def main(argv=None) -> int:
             )
             return 2
         print(f"queue conflict matrix -> {args.emit_conflict_matrix}")
+
+    if args.emit_lock_graph:
+        from . import lock_order
+
+        try:
+            doc = lock_order.emit_lock_graph(
+                args.root, args.emit_lock_graph,
+                witness_path=args.witness,
+                baseline_path=args.baseline,
+            )
+        except Exception as e:
+            print(
+                f"analysis error writing lock graph: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            f"lock graph -> {args.emit_lock_graph} "
+            f"(witness: {doc['witness']})"
+        )
 
     all_findings = [f for fs in by_pass.values() for f in fs]
 

@@ -15,8 +15,9 @@ replay, not poison every lookup that pages past it.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Tuple
+
+from cadence_tpu.utils.locks import make_guarded, make_lock
 
 from .record import ReplayCheckpoint
 
@@ -69,11 +70,15 @@ def _decode_many(blobs) -> List[ReplayCheckpoint]:
 
 class MemoryCheckpointStore(CheckpointStore):
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemoryCheckpointStore._lock")
         # (branch_key, event_id) -> json blob
-        self._rows: Dict[Tuple[str, int], str] = {}
+        self._rows: Dict[Tuple[str, int], str] = make_guarded(
+            {}, "MemoryCheckpointStore._rows", self._lock
+        )
         # (branch_key, event_id) -> tree_id (index for tree scans/GC)
-        self._tree: Dict[Tuple[str, int], str] = {}
+        self._tree: Dict[Tuple[str, int], str] = make_guarded(
+            {}, "MemoryCheckpointStore._tree", self._lock
+        )
 
     def put_checkpoint(self, ckpt: ReplayCheckpoint) -> None:
         blob = ckpt.to_json()

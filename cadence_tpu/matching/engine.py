@@ -26,6 +26,7 @@ from cadence_tpu.runtime.persistence.interfaces import TaskManager
 from cadence_tpu.runtime.persistence.records import TaskInfo
 from cadence_tpu.utils.clock import RealTimeSource, TimeSource
 from cadence_tpu.utils.dynamicconfig import Collection
+from cadence_tpu.utils.locks import make_guarded, make_lock
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP, Scope
 
@@ -78,9 +79,13 @@ class MatchingEngine:
         )
 
         instrument_methods(self, self.metrics, MATCHING_OPS)
-        self._lock = threading.Lock()
-        self._managers: Dict[tuple, TaskListManager] = {}
-        self._creating: Dict[tuple, threading.Lock] = {}
+        self._lock = make_lock("MatchingEngine._lock")
+        self._managers: Dict[tuple, TaskListManager] = make_guarded(
+            {}, "MatchingEngine._managers", self._lock
+        )
+        self._creating: Dict[tuple, object] = make_guarded(
+            {}, "MatchingEngine._creating", self._lock
+        )
         self._pollers: Dict[tuple, PollerHistory] = {}
         cfg = config or Collection()
         self._n_write_partitions = cfg.int_property(
@@ -91,8 +96,10 @@ class MatchingEngine:
         )
         self._tasklist_rps = cfg.float_property("matching.rps", 100000.0)
         # in-flight sync queries: query_id → (event, result slot)
-        self._query_lock = threading.Lock()
-        self._pending_queries: Dict[str, tuple] = {}
+        self._query_lock = make_lock("MatchingEngine._query_lock")
+        self._pending_queries: Dict[str, tuple] = make_guarded(
+            {}, "MatchingEngine._pending_queries", self._query_lock
+        )
 
     # -- manager registry ----------------------------------------------
 
@@ -108,8 +115,10 @@ class MatchingEngine:
             # take store leases, fencing each other's rangeID and
             # churning the lease on every creation race (ADVICE r4).
             # Serializing per key means the loser never constructs.
-            creating = self._creating.setdefault(key, threading.Lock())
-        with creating:
+            creating_lock = self._creating.setdefault(
+                key, make_lock("MatchingEngine.creating_lock")
+            )
+        with creating_lock:
             with self._lock:
                 mgr = self._managers.get(key)
             if mgr is not None:

@@ -14,6 +14,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from cadence_tpu.utils.locks import make_lock
 from cadence_tpu.utils.quotas import TokenBucket
 
 
@@ -41,7 +42,7 @@ class TaskMatcher:
         forward_offer: Optional[Callable[[object, float], bool]] = None,
         forward_poll: Optional[Callable[[float], object]] = None,
     ) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("TaskMatcher._lock")
         self._slots: deque[_PollSlot] = deque()
         self._limiter = rate_limiter
         # forwarder hooks (child partition → parent partition); see

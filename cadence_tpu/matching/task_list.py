@@ -19,6 +19,7 @@ from cadence_tpu.runtime.queues.ack import QueueAckManager
 from cadence_tpu.runtime.persistence.interfaces import TaskManager
 from cadence_tpu.runtime.persistence.records import TaskInfo, TaskListInfo
 from cadence_tpu.utils.clock import RealTimeSource, TimeSource
+from cadence_tpu.utils.locks import make_guarded, make_lock
 from cadence_tpu.utils.log import get_logger
 
 # taskID block leased per rangeID bump (reference rangeSize=100k)
@@ -124,8 +125,10 @@ class TaskWriter:
 
     def __init__(self, mgr: "TaskListManager") -> None:
         self._mgr = mgr
-        self._queue: List[_AppendRequest] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("TaskWriter._lock")
+        self._queue: List[_AppendRequest] = make_guarded(
+            [], "TaskWriter._queue", self._lock
+        )
         self._signal = threading.Event()
         self._stopped = threading.Event()
         self._thread = threading.Thread(
@@ -171,8 +174,17 @@ class TaskWriter:
         while True:
             self._signal.wait(timeout=0.1)
             self._signal.clear()
-            if self._stopped.is_set() and not self._queue:
-                return
+            if self._stopped.is_set():
+                # emptiness must be read under the lock: append() also
+                # checks _stopped under it, so either the request is
+                # already queued here (drained below) or its producer
+                # saw _stopped and raised — an append can no longer
+                # slip between an off-lock check and the pump's exit
+                # (found by the sanitizer's GUARDED-FIELD-RACE)
+                with self._lock:
+                    empty = not self._queue
+                if empty:
+                    return
             while True:
                 with self._lock:
                     batch = self._queue[: self.MAX_BATCH]
@@ -296,7 +308,7 @@ class TaskListManager:
         self._log = get_logger(
             "cadence_tpu.matching.tasklist", task_list=task_list_id.name
         )
-        self._write_lock = threading.Lock()
+        self._write_lock = make_lock("TaskListManager._write_lock")
         self._info = self._lease()
         # leased block: (rangeID-1)*RANGE_SIZE+1 .. rangeID*RANGE_SIZE
         self._next_task_id = (self._info.range_id - 1) * RANGE_SIZE + 1

@@ -8,9 +8,10 @@ machinery."""
 from __future__ import annotations
 
 import logging
-import threading
 import uuid
 from typing import Dict, List, Optional
+
+from cadence_tpu.utils.locks import make_guarded, make_rlock
 
 from .persistence.errors import EntityNotExistsError
 from .persistence.interfaces import MetadataManager
@@ -25,15 +26,21 @@ from .persistence.records import (
 class DomainCache:
     def __init__(self, metadata: MetadataManager) -> None:
         self.metadata = metadata
-        self._lock = threading.RLock()
-        self._by_id: Dict[str, DomainRecord] = {}
-        self._by_name: Dict[str, DomainRecord] = {}
+        self._lock = make_rlock("DomainCache._lock")
+        self._by_id: Dict[str, DomainRecord] = make_guarded(
+            {}, "DomainCache._by_id", self._lock
+        )
+        self._by_name: Dict[str, DomainRecord] = make_guarded(
+            {}, "DomainCache._by_name", self._lock
+        )
         self._version = -1
         self._failover_listeners: List = []
         # active-cluster snapshot per domain, taken at refresh time —
         # records can be mutated in place by callers, so the comparison
         # baseline must be the immutable string captured at insert
-        self._active_cluster: Dict[str, str] = {}
+        self._active_cluster: Dict[str, str] = make_guarded(
+            {}, "DomainCache._active_cluster", self._lock
+        )
 
     def add_failover_listener(self, fn) -> None:
         """fn(domain_id, old_active_cluster, new_active_cluster) — fired
@@ -59,8 +66,10 @@ class DomainCache:
         with self._lock:
             if v <= self._version:
                 return
-            old_active = self._active_cluster
-            self._active_cluster = {}
+            # copy-then-clear instead of rebinding: the guarded proxy
+            # (sanitizer mode) must stay the canonical container
+            old_active = dict(self._active_cluster)
+            self._active_cluster.clear()
             self._by_id.clear()
             self._by_name.clear()
             for rec in records:

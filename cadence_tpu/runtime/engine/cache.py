@@ -20,6 +20,8 @@ import weakref
 from collections import OrderedDict
 from typing import Callable, Tuple
 
+from cadence_tpu.utils.locks import make_lock
+
 from .context import WorkflowExecutionContext
 
 
@@ -28,7 +30,7 @@ class HistoryCache:
                  max_size: int = 1024) -> None:
         self._make = make_context
         self._max = max_size
-        self._lock = threading.Lock()
+        self._lock = make_lock("HistoryCache._lock")
         self._entries: "OrderedDict[Tuple[str, str, str], WorkflowExecutionContext]" = (
             OrderedDict()
         )

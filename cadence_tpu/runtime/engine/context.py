@@ -16,6 +16,7 @@ from cadence_tpu.core.active_transaction import TransactionResult
 from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.core.mutable_state import MutableState
 from cadence_tpu.core.tasks import ReplicationTask
+from cadence_tpu.utils.locks import make_rlock
 
 from ..persistence.records import (
     BranchToken,
@@ -39,7 +40,7 @@ class WorkflowExecutionContext:
         self.domain_id = domain_id
         self.workflow_id = workflow_id
         self.run_id = run_id
-        self.lock = threading.RLock()
+        self.lock = make_rlock("WorkflowExecutionContext.lock")
         self._ms: Optional[MutableState] = None
         self._condition = 0
         # invoked after every durable write (historyEventNotifier feed)

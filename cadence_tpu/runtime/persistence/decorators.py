@@ -101,7 +101,7 @@ class RateLimitedClient(_Wrapped):
 
 def wrap_bundle(bundle, metrics: Scope = NOOP,
                 max_qps: Optional[float] = None,
-                faults=None, effects=False):
+                faults=None, effects=False, sanitize=False):
     """Layer metrics (and optionally rate limits) over every manager in
     a PersistenceBundle, mirroring persistence-factory/factory.go.
 
@@ -117,6 +117,13 @@ def wrap_bundle(bundle, metrics: Scope = NOOP,
     must see the real store calls, so an injected error that never
     reached the backend is not recorded while a torn write that landed
     is. Testing-only, like ``faults``.
+
+    ``sanitize=True`` installs the concurrency sanitizer's store probe
+    (testing/race_witness.SanitizerProbeClient) OUTERMOST — every
+    attempted store call made while the caller holds a tracked lock is
+    a RUNTIME-LOCK-BLOCKING observation, injected faults included (a
+    fault that stalls the caller under a lock is as real a stall as a
+    slow backend). Testing-only, like ``faults``/``effects``.
     """
     from .interfaces import PersistenceBundle
 
@@ -134,6 +141,11 @@ def wrap_bundle(bundle, metrics: Scope = NOOP,
         )
 
         effect_client = EffectRecordingClient
+    sanitize_client = None
+    if sanitize:
+        from cadence_tpu.testing.race_witness import SanitizerProbeClient
+
+        sanitize_client = SanitizerProbeClient
 
     def deco(mgr, name):
         if mgr is None:
@@ -146,6 +158,8 @@ def wrap_bundle(bundle, metrics: Scope = NOOP,
         out = MetricsClient(out, metrics, manager=name)
         if max_qps is not None:
             out = RateLimitedClient(out, max_qps)
+        if sanitize_client is not None:
+            out = sanitize_client(out, manager=name)
         return out
 
     return PersistenceBundle(

@@ -10,13 +10,13 @@ from __future__ import annotations
 
 import bisect
 import copy
-import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from cadence_tpu.core.events import HistoryEvent, decode_batch, encode_batch
 from cadence_tpu.core.tasks import ReplicationTask, TimerTask, TransferTask
+from cadence_tpu.utils.locks import make_guarded, make_rlock
 
 from . import interfaces as I
 from .errors import (
@@ -48,13 +48,15 @@ _ZOMBIE = 3  # WorkflowState.Zombie
 
 class MemoryShardManager(I.ShardManager):
     def __init__(self) -> None:
-        self._shards: Dict[int, ShardInfo] = {}
+        self._lock = make_rlock("MemoryShardManager._lock")
+        self._shards: Dict[int, ShardInfo] = make_guarded(
+            {}, "MemoryShardManager._shards", self._lock
+        )
         # singleton routing-epoch row: (epoch, blob) or None
         self._reshard_state: Optional[Tuple[int, str]] = None
         # (shard_id, cluster) -> (version, blob): the consumer-side
         # replication cursor/mode rows (adaptive geo-replication)
         self._replication_progress: Dict[Tuple[int, str], Tuple[int, str]] = {}
-        self._lock = threading.RLock()
 
     def create_shard(self, info: ShardInfo) -> None:
         with self._lock:
@@ -122,7 +124,7 @@ class MemoryShardManager(I.ShardManager):
 class MemoryExecutionManager(I.ExecutionManager):
     def __init__(self, shard_manager: MemoryShardManager) -> None:
         self._shard_manager = shard_manager
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MemoryExecutionManager._lock")
         # (shard, domain, wf, run) -> (snapshot dict, next_event_id, last_write_version)
         self._executions: Dict[Tuple, Tuple[Dict[str, Any], int, int]] = {}
         # (shard, domain, wf) -> CurrentExecution
@@ -568,7 +570,7 @@ class MemoryExecutionManager(I.ExecutionManager):
 
 class MemoryHistoryManager(I.HistoryManager):
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MemoryHistoryManager._lock")
         # (tree_id, branch_id) -> {node_id -> (transaction_id, blob)}
         self._nodes: Dict[Tuple[str, str], Dict[int, Tuple[int, bytes]]] = {}
         # tree_id -> {branch_id -> BranchToken}
@@ -734,7 +736,7 @@ class MemoryHistoryManager(I.HistoryManager):
 
 class MemoryTaskManager(I.TaskManager):
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MemoryTaskManager._lock")
         self._lists: Dict[Tuple[str, str, int], TaskListInfo] = {}
         self._tasks: Dict[Tuple[str, str, int], Dict[int, TaskInfo]] = {}
 
@@ -832,7 +834,7 @@ class MemoryTaskManager(I.TaskManager):
 
 class MemoryMetadataManager(I.MetadataManager):
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MemoryMetadataManager._lock")
         self._by_id: Dict[str, DomainRecord] = {}
         self._name_to_id: Dict[str, str] = {}
         self._notification_version = 0
@@ -898,7 +900,7 @@ class MemoryMetadataManager(I.MetadataManager):
 
 class MemoryVisibilityManager(I.VisibilityManager):
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MemoryVisibilityManager._lock")
         # domain -> {(wf, run) -> record}
         self._open: Dict[str, Dict[Tuple[str, str], VisibilityRecord]] = {}
         self._closed: Dict[str, Dict[Tuple[str, str], VisibilityRecord]] = {}

@@ -13,13 +13,13 @@ from __future__ import annotations
 import json
 import sqlite3
 import time
-import threading
 import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 from cadence_tpu.core.events import HistoryEvent, decode_batch, encode_batch
 from cadence_tpu.core.tasks import ReplicationTask, TimerTask, TransferTask
+from cadence_tpu.utils.locks import make_rlock
 
 from . import interfaces as I
 from . import serde
@@ -73,7 +73,7 @@ class _Db:
         # manual transaction control: txn() issues BEGIN IMMEDIATE
         # itself; the driver must not inject its own deferred BEGINs
         self.conn.isolation_level = None
-        self.lock = threading.RLock()
+        self.lock = make_rlock("_Db.lock")
 
     @contextmanager
     def txn(self):

@@ -17,6 +17,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from cadence_tpu.utils.locks import make_guarded, make_lock
+
 _RUNNING = 0
 _DONE = 1
 _DEFERRED = 2
@@ -29,10 +31,12 @@ class QueueAckManager:
         ack_level,
         update_shard_ack: Optional[Callable[[object], None]] = None,
     ) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("QueueAckManager._lock")
         self.ack_level = ack_level  # int task_id or (ts, task_id) for timers
         self.read_level = ack_level
-        self._outstanding: Dict[object, int] = {}  # key → state
+        self._outstanding: Dict[object, int] = make_guarded(
+            {}, "QueueAckManager._outstanding", self._lock
+        )  # key → state
         self._update_shard_ack = update_shard_ack
         # last level KNOWN to have persisted: a transient checkpoint
         # failure leaves this behind ack_level, and the next sweep

@@ -17,6 +17,7 @@ import contextlib
 import time as _time
 
 from cadence_tpu.runtime.api import EntityNotExistsServiceError
+from cadence_tpu.utils.locks import make_lock
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP, Scope
 from cadence_tpu.utils.tracing import NOOP_SPAN, TRACER
@@ -38,7 +39,7 @@ class ResumeCursor:
     thread and ack-hook threads race on this state."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("ResumeCursor._lock")
         self._key = None
         self._gen = 0
 

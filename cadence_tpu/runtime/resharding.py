@@ -52,6 +52,7 @@ from fractions import Fraction
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from cadence_tpu.utils.hashing import fnv1a32
+from cadence_tpu.utils.locks import make_lock
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP
 
@@ -310,7 +311,7 @@ class ReshardCoordinator:
         self.metrics = (metrics if metrics is not None else NOOP).tagged(
             layer="resharding"
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReshardCoordinator._lock")
         # in-process cache of the durable shard-id high-water mark
         self._max_shard_id = 0
         self._log = get_logger("cadence_tpu.resharding")

@@ -9,10 +9,10 @@ scheme)."""
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
 
 from cadence_tpu.utils.clock import RealTimeSource, TimeSource
+from cadence_tpu.utils.locks import make_guarded, make_rlock
 
 from .persistence.errors import (
     EntityNotExistsError,
@@ -37,9 +37,13 @@ class ShardContext:
         self.persistence = persistence
         self.owner = owner
         self.time_source = time_source or RealTimeSource()
-        self._lock = threading.RLock()
-        self._remote_cluster_time: dict = {}
-        self._remote_time_listeners: list = []
+        self._lock = make_rlock("ShardContext._lock")
+        self._remote_cluster_time: dict = make_guarded(
+            {}, "ShardContext._remote_cluster_time", self._lock
+        )
+        self._remote_time_listeners: list = make_guarded(
+            [], "ShardContext._remote_time_listeners", self._lock
+        )
         self._fenced = False
         self._info = self._acquire()
         self._next_task_seq = 0
@@ -245,7 +249,10 @@ class ShardContext:
             cur = self._remote_cluster_time.get(cluster, 0)
             if now_ns > cur:
                 self._remote_cluster_time[cluster] = now_ns
-        for listener in list(self._remote_time_listeners):
+            # snapshot under the lock; fire outside it (listener code
+            # must not run under the shard lock)
+            listeners = list(self._remote_time_listeners)
+        for listener in listeners:
             listener(cluster, now_ns)
 
     def get_remote_cluster_current_time(self, cluster: str) -> int:
@@ -253,15 +260,20 @@ class ShardContext:
             return self._remote_cluster_time.get(cluster, 0)
 
     def add_remote_time_listener(self, fn) -> None:
-        self._remote_time_listeners.append(fn)
+        # under the lock: registration races with the replication
+        # pump's snapshot in set_remote_cluster_current_time (the
+        # sanitizer's GUARDED-FIELD-RACE caught the bare append)
+        with self._lock:
+            self._remote_time_listeners.append(fn)
 
     def remove_remote_time_listener(self, fn) -> None:
         """Detach a listener (standby processor stop): a dead processor
         must not stay reachable from the shard's listener list."""
-        try:
-            self._remote_time_listeners.remove(fn)
-        except ValueError:
-            pass
+        with self._lock:
+            try:
+                self._remote_time_listeners.remove(fn)
+            except ValueError:
+                pass
 
     def get_replication_ack_level(self) -> int:
         with self._lock:

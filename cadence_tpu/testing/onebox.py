@@ -42,6 +42,7 @@ class Onebox:
         time_source=None,
         poll_request_id_fn=None,
         checkpoints=None,
+        sanitize: bool = False,
     ) -> None:
         self.faults = faults
         self.persistence = persistence or create_memory_bundle()
@@ -55,9 +56,13 @@ class Onebox:
         from cadence_tpu.runtime.persistence.decorators import wrap_bundle
         from cadence_tpu.utils.metrics import Scope
 
+        # sanitize: the concurrency sanitizer's store probe
+        # (RUNTIME-LOCK-BLOCKING) — pair with a RaceWitness installed
+        # via utils/locks.wrap_locks BEFORE constructing the box
         self.metrics = Scope()
         self.persistence = wrap_bundle(
-            self.persistence, metrics=self.metrics, faults=faults
+            self.persistence, metrics=self.metrics, faults=faults,
+            sanitize=sanitize,
         )
         self.bus = MessageBus()
         self.cluster_metadata = cluster_metadata or ClusterMetadata()

@@ -29,10 +29,11 @@ attribution, never process memory.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
+
+from cadence_tpu.utils.locks import make_guarded, make_lock
 
 TagTuple = Tuple[Tuple[str, str], ...]
 
@@ -169,12 +170,18 @@ class Registry:
     """Process-wide metric store; thread-safe, cardinality-capped."""
 
     def __init__(self, max_series: int = _DEFAULT_MAX_SERIES) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("Registry._lock")
         self._max_series = max(int(max_series), 1)
         self._series = 0
-        self._counters: Dict[Tuple[str, TagTuple], int] = defaultdict(int)
-        self._gauges: Dict[Tuple[str, TagTuple], float] = {}
-        self._timers: Dict[Tuple[str, TagTuple], Histogram] = {}
+        self._counters: Dict[Tuple[str, TagTuple], int] = make_guarded(
+            defaultdict(int), "Registry._counters", self._lock
+        )
+        self._gauges: Dict[Tuple[str, TagTuple], float] = make_guarded(
+            {}, "Registry._gauges", self._lock
+        )
+        self._timers: Dict[Tuple[str, TagTuple], Histogram] = make_guarded(
+            {}, "Registry._timers", self._lock
+        )
 
     def _admit(self, table, name: str, tags: TagTuple):
         """Series admission under the lock: an existing key passes; a

@@ -16,6 +16,14 @@
 #       # convergence, partition-window recovery, torn snapshot
 #       # transfer falling back to event shipping — every seed
 #       # re-proves the standby byte-identical to the healthy-link run
+#   CHAOS_SANITIZE=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # concurrency-sanitizer sweep: the runtime lock/race witness
+#       # under the write-fault storm — zero unwaived findings
+#       # (RUNTIME-LOCK-INVERSION / RUNTIME-LOCK-BLOCKING /
+#       # GUARDED-FIELD-RACE / RUNTIME-EDGE-UNKNOWN), byte-identical
+#       # replay with the instrumentation installed, and a refreshed
+#       # build/lock_witness.json for scripts/run_lint.sh
+#       # --emit-lock-graph
 #
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
@@ -29,6 +37,9 @@ if [[ -n "${CHAOS_RESHARD:-}" ]]; then
 fi
 if [[ -n "${CHAOS_LINK:-}" ]]; then
     FILTER=(-k TestLinkChaos)
+fi
+if [[ -n "${CHAOS_SANITIZE:-}" ]]; then
+    FILTER=(-k TestSanitizedChaos)
 fi
 
 run_one() {

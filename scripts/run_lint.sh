@@ -23,8 +23,14 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
+# --emit-lock-graph: the static lock inventory + acquisition-order
+# edges, annotated observed/never-observed against the latest runtime
+# witness (build/lock_witness.json — refreshed by the sanitized tier-1
+# test and CHAOS_SANITIZE=1 sweeps); annotations read "unknown" until
+# a sanitized suite has run
 exec python -m cadence_tpu.analysis \
     --baseline config/lint_baseline.json \
     --strict-stale \
     --emit-conflict-matrix build/queue_conflict_matrix.json \
+    --emit-lock-graph build/lock_graph.json \
     "$@"

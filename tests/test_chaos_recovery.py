@@ -81,15 +81,16 @@ class ChaosBox:
     monitor whose history ring lists every host (the reshard chaos
     family kills hosts mid-handoff)."""
 
-    def __init__(self, faults=None, num_shards=1, hosts=1, effects=False):
+    def __init__(self, faults=None, num_shards=1, hosts=1, effects=False,
+                 sanitize=False):
         from cadence_tpu.runtime.membership import Monitor
 
         self.metrics = Scope()
         self.persistence = create_memory_bundle()
-        if faults is not None or effects:
+        if faults is not None or effects or sanitize:
             self.persistence = wrap_bundle(
                 self.persistence, metrics=self.metrics, faults=faults,
-                effects=effects,
+                effects=effects, sanitize=sanitize,
             )
         self.domain_handler = DomainHandler(
             self.persistence.metadata, ClusterMetadata()
@@ -579,6 +580,87 @@ class TestEffectWitness:
             ("transfer", "DecisionTask", "execution",
              "update_workflow_execution")
         ]
+
+
+# ---------------------------------------------------------------------------
+# concurrency sanitizer under the storm (CHAOS_SANITIZE=1 sweeps this)
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizedChaos:
+    """The runtime lock/race witness under the ≥10% write-fault storm —
+    the regime where retries, torn-write recovery and park/drain loops
+    walk lock paths a clean run never touches. Zero unwaived findings
+    and full cross-validation against the static Pass 3 graph are the
+    acceptance bar (ISSUE 12); the witness artifact is refreshed for
+    ``--emit-lock-graph``."""
+
+    def test_storm_zero_unwaived_findings(self):
+        from cadence_tpu.testing.race_witness import (
+            RaceWitness,
+            check_race_witness,
+            cross_validate,
+        )
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))
+        sched = _write_fault_schedule(CHAOS_SEED)
+        w = RaceWitness().install()
+        try:
+            box = ChaosBox(faults=sched, sanitize=True)
+            try:
+                _drive_workflows(box, ["san-wf-1", "san-wf-2"])
+            finally:
+                box.stop()
+        finally:
+            w.uninstall()
+
+        # the storm actually hit (same floor as the differential suite)
+        assert sched.injected_total() > 0, sched.snapshot()
+        # traffic exercised the tracked plane
+        assert w.observed_edges(), "no lock edges observed under storm"
+
+        from cadence_tpu.analysis import lock_order
+
+        graph = lock_order.build_graph(repo_root)
+        unwaived = check_race_witness(w, repo_root, graph=graph)
+        assert unwaived == [], "\n".join(f.format() for f in unwaived)
+
+        # bidirectional proof, dynamic → static direction: every
+        # observed edge either exists statically or carries a waiver
+        # (cross_validate findings are a subset of the checked set)
+        for f in cross_validate(w, repo_root, graph=graph):
+            assert f.rule == "RUNTIME-EDGE-UNKNOWN"
+
+        # refresh the artifact input for --emit-lock-graph
+        w.save(os.path.join(repo_root, "build", "lock_witness.json"))
+
+    def test_sanitizer_preserves_differential_replay(self):
+        """The instrumentation must be an observer: the same seeded
+        storm produces byte-identical histories with and without the
+        sanitizer installed."""
+        from cadence_tpu.testing.race_witness import RaceWitness
+
+        wids = ["san-diff-1", "san-diff-2"]
+        plain_box = ChaosBox(faults=_write_fault_schedule(CHAOS_SEED))
+        try:
+            plain = _drive_workflows(plain_box, wids)
+        finally:
+            plain_box.stop()
+
+        w = RaceWitness().install()
+        try:
+            box = ChaosBox(
+                faults=_write_fault_schedule(CHAOS_SEED), sanitize=True
+            )
+            try:
+                sanitized = _drive_workflows(box, wids)
+            finally:
+                box.stop()
+        finally:
+            w.uninstall()
+        assert plain == sanitized
 
 
 # ---------------------------------------------------------------------------

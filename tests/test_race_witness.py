@@ -1,0 +1,633 @@
+"""Runtime concurrency sanitizer: rule fixtures, overhead guards, the
+sanitized Onebox traffic acceptance test, and the lock-graph artifact.
+
+Mirrors the static-analysis test discipline: every runtime rule gets a
+known-bad fixture proving it FIRES and a clean fixture proving it stays
+quiet (a sanitizer that can't fail proves nothing); the disabled path
+is asserted to install zero instrumentation (the same contract as
+``wrap_bundle(faults=None)``); and the tier-1 acceptance drive runs a
+real Onebox under the witness, requiring zero unwaived findings and
+full cross-validation against the static Pass 3 graph.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from cadence_tpu.testing.race_witness import (
+    GUARDED_FIELDS,
+    RaceWitness,
+    SanitizerProbeClient,
+    check_race_witness,
+    cross_validate,
+)
+from cadence_tpu.utils import locks
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RAW_LOCK_TYPE = type(threading.Lock())
+_RAW_RLOCK_TYPE = type(threading.RLock())
+
+
+# ---------------------------------------------------------------------------
+# disabled path: zero instrumentation
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledPath:
+    def test_factory_returns_raw_primitives(self):
+        assert not locks.tracking_enabled()
+        before = locks.constructed_count()
+        lk = locks.make_lock("X._lock")
+        rlk = locks.make_rlock("X._rlock")
+        cond = locks.make_condition(name="X._cond")
+        assert type(lk) is _RAW_LOCK_TYPE
+        assert type(rlk) is _RAW_RLOCK_TYPE
+        assert type(cond) is threading.Condition
+        assert locks.constructed_count() == before
+
+    def test_make_guarded_returns_container_unchanged(self):
+        d, li = {}, []
+        lk = locks.make_lock("X._lock")
+        assert locks.make_guarded(d, "X._d", lk) is d
+        assert locks.make_guarded(li, "X._l", lk) is li
+
+    def test_runtime_components_construct_untracked(self):
+        """The hot classes' construction sites go through the factory;
+        with no witness installed they must hold raw primitives and
+        build no wrappers — the chaos machinery's zero-cost contract."""
+        from cadence_tpu.runtime.queues.ack import QueueAckManager
+        from cadence_tpu.utils.metrics import Registry
+
+        before = locks.constructed_count()
+        mgr = QueueAckManager(0)
+        reg = Registry()
+        assert type(mgr._lock) is _RAW_LOCK_TYPE
+        assert type(reg._lock) is _RAW_LOCK_TYPE
+        assert type(mgr._outstanding) is dict
+        assert locks.constructed_count() == before
+
+    def test_held_locks_empty_when_disabled(self):
+        assert locks.held_locks() == ()
+        locks.note_blocking("store", "x.y")  # must be a no-op
+
+
+# ---------------------------------------------------------------------------
+# known-bad fixtures: each rule fires
+# ---------------------------------------------------------------------------
+
+
+class TestRuntimeRules:
+    def test_abba_inversion_fires_with_both_sites(self):
+        with RaceWitness() as w:
+            a = locks.make_lock("FixtureA._a")
+            b = locks.make_lock("FixtureB._b")
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            t1 = threading.Thread(target=ab)
+            t1.start()
+            t1.join()
+            t2 = threading.Thread(target=ba)
+            t2.start()
+            t2.join()
+
+            found = [
+                f for f in w.findings()
+                if f.rule == "RUNTIME-LOCK-INVERSION"
+            ]
+            assert len(found) == 1
+            # both threads' acquisition sites ride in the report
+            assert "ab" in found[0].message and "ba" in found[0].message
+
+    def test_guarded_field_race_fires_off_lock_second_thread(self):
+        with RaceWitness() as w:
+            guard = locks.make_lock("Fixture._lock")
+            shared = locks.make_guarded({}, "Fixture._shared", guard)
+            with guard:
+                shared["init"] = 1  # owner thread, under lock
+
+            def off_lock_write():
+                shared["boom"] = 2  # second thread, NO lock
+
+            t = threading.Thread(target=off_lock_write)
+            t.start()
+            t.join()
+            races = [
+                f for f in w.findings()
+                if f.rule == "GUARDED-FIELD-RACE"
+            ]
+            assert races, w.findings()
+            assert "Fixture._shared" in races[0].anchor
+
+    def test_guarded_list_race_fires(self):
+        with RaceWitness() as w:
+            guard = locks.make_lock("Fixture._lock")
+            shared = locks.make_guarded([], "Fixture._items", guard)
+            with guard:
+                shared.append(1)
+            t = threading.Thread(target=lambda: shared.append(2))
+            t.start()
+            t.join()
+            assert any(
+                f.rule == "GUARDED-FIELD-RACE"
+                and "Fixture._items" in f.anchor
+                for f in w.findings()
+            )
+
+    def test_inplace_mutation_does_not_bypass_guard(self):
+        """`lst += [...]` / `d |= other` resolve to the in-place
+        dunders, not append/update — they must still report (the
+        silent-bypass hole a review pass caught)."""
+        def iadd_list(lst):
+            lst += [99]
+
+        def ior_dict(d):
+            d |= {"k": 1}
+
+        for container, mutate in (([], iadd_list), ({}, ior_dict)):
+            with RaceWitness() as w:
+                guard = locks.make_lock("Fixture._lock")
+                shared = locks.make_guarded(
+                    container, "Fixture._shared", guard
+                )
+                with guard:
+                    mutate(shared)
+                t = threading.Thread(target=mutate, args=(shared,))
+                t.start()
+                t.join()
+                assert any(
+                    f.rule == "GUARDED-FIELD-RACE" for f in w.findings()
+                ), f"in-place mutation bypassed guard on {type(container)}"
+
+    def test_store_write_under_tracked_lock_fires(self):
+        class FakeStore:
+            def update_shard(self, info):
+                return "ok"
+
+        with RaceWitness() as w:
+            lk = locks.make_lock("Fixture._lock")
+            probe = SanitizerProbeClient(FakeStore(), manager="shard")
+            with lk:
+                assert probe.update_shard(None) == "ok"
+            blocked = [
+                f for f in w.findings()
+                if f.rule == "RUNTIME-LOCK-BLOCKING"
+            ]
+            assert len(blocked) == 1
+            assert blocked[0].anchor.endswith(":_lock:update_shard")
+
+    def test_sleep_under_tracked_lock_fires(self):
+        with RaceWitness() as w:
+            lk = locks.make_lock("Fixture._lock")
+            with lk:
+                time.sleep(0)  # patched entry point
+            assert any(
+                f.rule == "RUNTIME-LOCK-BLOCKING"
+                and f.anchor.endswith(":_lock:sleep")
+                for f in w.findings()
+            )
+
+    def test_trylock_records_no_order_edge(self):
+        """acquire(blocking=False) cannot deadlock: it must not mint an
+        acquisition-order edge (the static pass exempts try-locks the
+        same way) — but the hold is real, so guarded-field checks
+        still see it."""
+        with RaceWitness() as w:
+            a = locks.make_lock("TryA._a")
+            b = locks.make_lock("TryB._b")
+            guard = locks.make_lock("Try._g")
+            shared = locks.make_guarded({}, "Try._shared", guard)
+            with a:
+                assert b.acquire(blocking=False)
+                b.release()
+            # the try-held guard still counts as held
+            assert guard.acquire(blocking=False)
+            shared["k"] = 1
+            guard.release()
+            t = threading.Thread(target=lambda: (
+                guard.acquire(), shared.__setitem__("k2", 2),
+                guard.release()))
+            t.start()
+            t.join()
+            assert w.observed_edges() == []
+            assert w.findings() == []
+
+    def test_guarded_exempt_site_upgraded_by_later_race(self):
+        """An owner-thread off-lock access during init must not mask a
+        later genuine race at the SAME site: the worst observation per
+        anchor wins."""
+        with RaceWitness() as w:
+            guard = locks.make_lock("Up._lock")
+            shared = locks.make_guarded({}, "Up._shared", guard)
+
+            def touch():  # one anchor for every access
+                shared["k"] = threading.get_ident()
+
+            touch()  # owner, pre-sharing: exempt
+            t = threading.Thread(target=touch)  # same site, 2nd thread
+            t.start()
+            t.join()
+            races = [
+                f for f in w.findings()
+                if f.rule == "GUARDED-FIELD-RACE"
+            ]
+            assert races, "later race masked by exempt init record"
+
+    def test_clean_fixture_stays_clean(self):
+        """Falsifiability control: consistent order, guarded accesses
+        under the lock, store I/O outside it — zero findings."""
+
+        class FakeStore:
+            def update_shard(self, info):
+                return "ok"
+
+        with RaceWitness() as w:
+            a = locks.make_lock("CleanA._a")
+            b = locks.make_lock("CleanB._b")
+            guard = locks.make_lock("Clean._lock")
+            shared = locks.make_guarded({}, "Clean._shared", guard)
+            probe = SanitizerProbeClient(FakeStore(), manager="shard")
+
+            def worker():
+                with a:
+                    with b:
+                        pass
+                with guard:
+                    shared["k"] = threading.get_ident()
+                probe.update_shard(None)  # no lock held
+
+            threads = [threading.Thread(target=worker) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert w.findings() == []
+
+    def test_condition_wait_releases_tracked_lock(self):
+        """cv.wait on a tracked lock must not leave a stale hold on the
+        parked thread (the static pass's held-cond-wait exemption,
+        dynamically)."""
+        with RaceWitness() as w:
+            lk = locks.make_lock("Fixture._lock")
+            cv = threading.Condition(lk)
+            entered = threading.Event()
+
+            def waiter():
+                with cv:
+                    entered.set()
+                    cv.wait(timeout=5)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            entered.wait(5)
+            # while the waiter is parked, the lock must be acquirable
+            # and the acquiring thread must see a consistent stack
+            acquired = lk.acquire(timeout=2)
+            assert acquired
+            lk.release()
+            with cv:
+                cv.notify_all()
+            t.join(5)
+            assert not t.is_alive()
+            assert w.findings() == []
+
+
+# ---------------------------------------------------------------------------
+# cross-validation against the static graph
+# ---------------------------------------------------------------------------
+
+
+class TestCrossValidation:
+    def _witness_with_edge(self, a_name, b_name):
+        w = RaceWitness()
+        w.install()
+        try:
+            a = locks.TrackedLock(a_name)
+            b = locks.TrackedLock(b_name)
+            with a:
+                with b:
+                    pass
+        finally:
+            w.uninstall()
+        return w
+
+    def test_unknown_edge_is_a_finding(self):
+        w = self._witness_with_edge(
+            "tests/fixture.py:Nowhere._x", "tests/fixture.py:Nowhere._y"
+        )
+        out = cross_validate(w, REPO_ROOT)
+        assert len(out) == 1
+        assert out[0].rule == "RUNTIME-EDGE-UNKNOWN"
+
+    def test_static_edge_is_not_a_finding(self):
+        # ShardContext._lock → MemoryShardManager._lock is in the
+        # static graph (update_*_ack_level → update_shard closure)
+        w = self._witness_with_edge(
+            "cadence_tpu/runtime/shard.py:ShardContext._lock",
+            "cadence_tpu/runtime/persistence/memory.py:"
+            "MemoryShardManager._lock",
+        )
+        assert cross_validate(w, REPO_ROOT) == []
+
+    def test_waiver_file_suppresses_known_holes(self):
+        # the documented decorator-indirection hole: any edge into the
+        # Registry leaf lock
+        w = self._witness_with_edge(
+            "cadence_tpu/runtime/shard.py:ShardContext._lock",
+            "cadence_tpu/utils/metrics.py:Registry._lock",
+        )
+        assert cross_validate(w, REPO_ROOT), "edge should be unknown"
+        assert check_race_witness(w, REPO_ROOT) == []
+
+    def test_static_blocking_baseline_waives_runtime_twin(self):
+        """A runtime blocking observation anchored inside a baselined
+        static LOCK-BLOCKING family is evidence, not an alarm."""
+
+        class FakeStore:
+            def update_shard(self, info):
+                return "ok"
+
+        w = RaceWitness()
+        w.install()
+        try:
+            # same name shape the real ShardContext produces
+            lk = locks.TrackedLock(
+                "cadence_tpu/runtime/shard.py:ShardContext._lock"
+            )
+            probe = SanitizerProbeClient(FakeStore(), manager="shard")
+
+            # the acquire SITE matters for the anchor: fabricate it via
+            # a helper whose name lands outside the baselined pattern,
+            # then check the raw finding is waived only by anchor match
+            with lk:
+                probe.update_shard(None)
+        finally:
+            w.uninstall()
+        raw = [
+            f for f in w.findings() if f.rule == "RUNTIME-LOCK-BLOCKING"
+        ]
+        assert len(raw) == 1
+        unwaived = check_race_witness(w, REPO_ROOT)
+        # the fixture's acquire site (this test class) does NOT match
+        # the ShardContext.* baseline anchor, so it must survive —
+        # proving the waiver is anchored, not rule-wide
+        assert any(f.rule == "RUNTIME-LOCK-BLOCKING" for f in unwaived)
+
+
+# ---------------------------------------------------------------------------
+# overhead guards
+# ---------------------------------------------------------------------------
+
+
+class TestOverhead:
+    def test_enabled_path_overhead_bounded(self):
+        """Tracked acquire/release vs raw — the sanitizer is a testing
+        mode, but it must stay usable under the chaos storm. The bound
+        is deliberately loose (frame inspection per acquire); the
+        measured ratio is recorded in the README sanitizer docs."""
+        N = 2000
+        raw = threading.Lock()
+        t0 = time.perf_counter()
+        for _ in range(N):
+            with raw:
+                pass
+        raw_s = time.perf_counter() - t0
+
+        with RaceWitness():
+            tracked = locks.make_lock("Bench._lock")
+            t0 = time.perf_counter()
+            for _ in range(N):
+                with tracked:
+                    pass
+            tracked_s = time.perf_counter() - t0
+
+        ratio = tracked_s / max(raw_s, 1e-9)
+        assert ratio < 500, (
+            f"tracked lock {ratio:.0f}x raw — instrumentation regressed"
+        )
+
+    def test_uninstall_restores_patched_entry_points(self):
+        orig_sleep = time.sleep
+        orig_join = threading.Thread.join
+        with RaceWitness():
+            assert time.sleep is not orig_sleep
+            assert threading.Thread.join is not orig_join
+        assert time.sleep is orig_sleep
+        assert threading.Thread.join is orig_join
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 acceptance drive: sanitized Onebox traffic
+# ---------------------------------------------------------------------------
+
+
+def _drive_sanitized_box(num_workflows=2):
+    from cadence_tpu.runtime.api import StartWorkflowRequest
+    from cadence_tpu.testing.onebox import Onebox
+    from cadence_tpu.worker import Worker
+
+    w = RaceWitness().install()
+    try:
+        box = Onebox(num_shards=2, sanitize=True).start()
+        try:
+            box.domain_handler.register_domain("san-dom")
+            wkr = Worker(box.frontend, "san-dom", "san-tl",
+                         identity="san-worker")
+
+            def wf(ctx, input):
+                a = yield ctx.schedule_activity("double", input)
+                return a
+
+            wkr.register_workflow("san-wf", wf)
+            wkr.register_activity("double", lambda i: i * 2)
+            wkr.start()
+            try:
+                for i in range(num_workflows):
+                    rid = box.frontend.start_workflow_execution(
+                        StartWorkflowRequest(
+                            domain="san-dom", workflow_id=f"san-{i}",
+                            workflow_type="san-wf", task_list="san-tl",
+                            input=b"x", request_id=f"san-req-{i}",
+                            execution_start_to_close_timeout_seconds=60,
+                        )
+                    )
+                    deadline = time.monotonic() + 30
+                    while time.monotonic() < deadline:
+                        d = box.frontend.describe_workflow_execution(
+                            "san-dom", f"san-{i}", rid
+                        )
+                        if not d.is_running:
+                            break
+                        time.sleep(0.02)
+                    else:
+                        raise AssertionError(f"san-{i} did not complete")
+            finally:
+                wkr.stop()
+        finally:
+            box.stop()
+    finally:
+        w.uninstall()
+    return w
+
+
+class TestSanitizedOnebox:
+    def test_traffic_zero_unwaived_findings_and_witness_artifact(self):
+        """The acceptance drive: real Onebox traffic under the witness.
+
+        Asserts (1) zero unwaived runtime findings, (2) every
+        runtime-observed lock edge is cross-validated against the
+        static Pass 3 graph (unknown ⇒ finding ⇒ would fail (1)),
+        (3) the declared guarded-field table actually instantiated,
+        and (4) the witness artifact round-trips through the
+        ``--emit-lock-graph`` annotation machinery with at least one
+        baselined entry flipped to *observed*."""
+        from cadence_tpu.analysis import lock_order
+
+        w = _drive_sanitized_box()
+
+        # one static graph for the whole gate (check + validate + emit)
+        graph = lock_order.build_graph(REPO_ROOT)
+        unwaived = check_race_witness(w, REPO_ROOT, graph=graph)
+        assert unwaived == [], "\n".join(f.format() for f in unwaived)
+
+        # traffic actually exercised the lock plane
+        edges = w.observed_edges()
+        assert edges, "no lock edges observed — tracking broken"
+
+        # the declared guarded-field table is live (short names: the
+        # registered keys carry the constructing module prefix)
+        registered_short = {
+            name.rsplit(":", 1)[-1]
+            for name in w.registered_guard_fields()
+        }
+        missing = set(GUARDED_FIELDS) - registered_short
+        assert not missing, f"guarded fields never constructed: {missing}"
+
+        # persist the witness + emit the annotated lock graph
+        from cadence_tpu.analysis import artifact
+
+        wpath = os.path.join(REPO_ROOT, "build", "lock_witness.json")
+        w.save(wpath)
+        gpath = os.path.join(REPO_ROOT, "build", "lock_graph.json")
+        doc = lock_order.emit_lock_graph(
+            REPO_ROOT, gpath, witness_path=wpath
+        )
+        loaded = artifact.load_artifact(gpath, "lock_graph")
+        assert loaded["witness"] == wpath
+        entries = loaded["baseline_entries"]
+        assert entries, "no baselined lock entries annotated"
+        statuses = {e["status"] for e in entries}
+        assert statuses <= {"observed", "never-observed"}
+        # the entity-lock / shard-lease families run on every write —
+        # a traffic drive must observe at least one of them
+        assert any(e["status"] == "observed" for e in entries), entries
+        # every annotated entry still matches a static finding
+        # (--strict-stale's invariant, restated on the artifact)
+        assert all(e["matches_static"] >= 1 for e in entries)
+        # runtime-only edges surface in the artifact 1:1 with the
+        # RUNTIME-EDGE-UNKNOWN findings; unwaived == [] above already
+        # proved each one carries a written waiver
+        assert len(doc["runtime_only_edges"]) == len(
+            cross_validate(w, REPO_ROOT, graph=graph)
+        )
+
+
+# ---------------------------------------------------------------------------
+# lock-graph artifact plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestLockGraphArtifact:
+    def test_emit_without_witness_annotates_unknown(self, tmp_path):
+        from cadence_tpu.analysis import artifact, lock_order
+
+        path = str(tmp_path / "lock_graph.json")
+        doc = lock_order.emit_lock_graph(
+            REPO_ROOT, path, witness_path=str(tmp_path / "missing.json")
+        )
+        loaded = artifact.load_artifact(path, "lock_graph")
+        assert loaded["schema_version"] == artifact.SCHEMA_VERSION
+        assert "no witness artifact" in doc["witness"]
+        assert all(e["observed"] is None for e in doc["edges"])
+        assert all(
+            e["status"] == "unknown" for e in doc["baseline_entries"]
+        )
+        # the static inventory covers the newly scoped serving edge
+        lock_ids = {l["id"] for l in loaded["locks"]}
+        assert (
+            "cadence_tpu/frontend/admin_handler.py:"
+            "AdminHandler._resharder_lock" in lock_ids
+        )
+        assert any("client/routed.py" in l for l in lock_ids)
+
+    def test_wrong_kind_rejected(self, tmp_path):
+        from cadence_tpu.analysis import artifact
+
+        path = str(tmp_path / "x.json")
+        artifact.write_artifact(path, "something_else", {})
+        with pytest.raises(ValueError):
+            artifact.load_artifact(path, "lock_graph")
+
+    def test_inversion_baseline_entry_annotates_observed(self, tmp_path):
+        """A baselined static LOCK-INVERSION entry flips to observed
+        when the witness saw the same inversion — the runtime-
+        anchor prefix must not defeat the fnmatch."""
+        import json
+
+        from cadence_tpu.analysis import artifact, lock_order
+
+        wpath = str(tmp_path / "witness.json")
+        artifact.write_artifact(wpath, "lock_witness", {
+            "edges": [], "blocking": [],
+            "findings": [{
+                "rule": "RUNTIME-LOCK-INVERSION",
+                "anchor": "runtime-inversion:x<->y",
+                "message": "m",
+            }],
+        })
+        bpath = str(tmp_path / "baseline.json")
+        with open(bpath, "w") as f:
+            json.dump({"findings": [{
+                "rule": "LOCK-INVERSION",
+                "anchor": "inversion:x<->y",
+                "justification": "fixture",
+            }]}, f)
+        doc = lock_order.emit_lock_graph(
+            REPO_ROOT, str(tmp_path / "graph.json"),
+            witness_path=wpath, baseline_path=bpath,
+        )
+        (entry,) = doc["baseline_entries"]
+        assert entry["status"] == "observed"
+
+    def test_edge_normalization(self):
+        from cadence_tpu.analysis.lock_order import edge_in_static
+
+        static = [(
+            "cadence_tpu/runtime/engine/engine.py:HistoryEngine:ctx.lock",
+            "cadence_tpu/runtime/shard.py:ShardContext._lock",
+        )]
+        # expression-form static endpoint matches by attr; self-form
+        # matches by Class.attr
+        assert edge_in_static((
+            "cadence_tpu/runtime/engine/context.py:"
+            "WorkflowExecutionContext.lock",
+            "cadence_tpu/runtime/shard.py:ShardContext._lock",
+        ), static)
+        assert not edge_in_static((
+            "cadence_tpu/runtime/engine/context.py:"
+            "WorkflowExecutionContext.lock",
+            "cadence_tpu/runtime/shard.py:OtherClass._lock",
+        ), static)

