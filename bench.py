@@ -678,9 +678,9 @@ def _bench_replication_lag(workflows: int, signals_each: int,
         def __init__(self, svc):
             self.svc = svc
 
-        def get_replication_messages(self, shard_id, last):
+        def get_replication_messages(self, shard_id, last, max_tasks=None):
             return self.svc.get_replication_messages(
-                shard_id, last, cluster="standby")
+                shard_id, last, cluster="standby", max_tasks=max_tasks)
 
         def get_workflow_history_raw(self, *a):
             return self.svc.get_workflow_history_raw(*a)
@@ -819,6 +819,245 @@ def _bench_replication_lag(workflows: int, signals_each: int,
     )
     out["link_bytes_per_s"] = bytes_per_s
     return out
+
+
+def _bench_failover_drill(workflows: int, signals_each: int,
+                          bytes_per_s: float,
+                          unavailability_slo_ms: float = 5000.0,
+                          payload: int = 96):
+    """Domain failover drills over a throttled WAN link
+    (runtime/replication/failover.py; README "Domain failover").
+
+    One two-cluster pair runs all three drill shapes in sequence:
+
+      managed   graceful handover active->standby: the handover pays
+                the backlog catch-up through the throttled link before
+                the flip (handover_ms), with a metadata-only
+                unavailability window (unavailability_ms) and a
+                drained link at promote time (lag 0)
+      forced    region loss: the link partitions with divergent events
+                outstanding on the now-active side, the survivor is
+                promoted blind (unavailability_ms = flip->observed)
+      failback  the recovered region re-syncs, the version-branch
+                storm resolves (conflicts_resolved — the NDC
+                rebuild-at-LCA path), and ownership returns home
+
+    The ``slo`` block is the contract the smoke test pins: every
+    drill's unavailability window inside ``unavailability_slo_ms``,
+    at least one conflict actually resolved, and zero replication lag
+    after the final convergence.
+    """
+    import uuid as _uuid
+
+    from cadence_tpu.client import HistoryClient, MatchingClient
+    from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+    from cadence_tpu.matching import MatchingEngine
+    from cadence_tpu.runtime.api import SignalRequest, StartWorkflowRequest
+    from cadence_tpu.runtime.domains import DomainCache, register_domain
+    from cadence_tpu.runtime.membership import single_host_monitor
+    from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+    from cadence_tpu.runtime.replication import (
+        AdaptiveTransport,
+        ClusterHandle,
+        DomainFailoverCoordinator,
+        HistoryRereplicator,
+        ReplicationTaskFetcher,
+        ReplicationTaskProcessor,
+    )
+    from cadence_tpu.runtime.service import HistoryService
+    from cadence_tpu.testing.faults import (
+        LinkPartitionedError,
+        LinkProfile,
+        chaos_link,
+    )
+    from cadence_tpu.utils.metrics import Scope
+
+    DOMAIN = "fo-bench"
+    domain_id = str(_uuid.uuid4())
+
+    def meta(name):
+        return ClusterMetadata(
+            failover_version_increment=10,
+            master_cluster_name="active", current_cluster_name=name,
+            cluster_info={
+                "active": ClusterInformation(initial_failover_version=1),
+                "standby": ClusterInformation(initial_failover_version=2),
+            },
+        )
+
+    def make_cluster(name):
+        scope = Scope()
+        persistence = create_memory_bundle()
+        register_domain(
+            persistence.metadata, DOMAIN, is_global=True,
+            clusters=["active", "standby"], active_cluster="active",
+            domain_id=domain_id, failover_version=1,
+        )
+        domains = DomainCache(persistence.metadata)
+        svc = HistoryService(
+            1, persistence, domains, single_host_monitor(f"fo-{name}"),
+            cluster_metadata=meta(name), metrics=scope,
+        )
+        hc = HistoryClient(svc.controller)
+        matching = MatchingEngine(persistence.task, hc)
+        svc.wire(MatchingClient(matching), hc)
+        svc.start()
+        svc.controller.get_engine_for_shard(0)\
+            .replicator_queue.batch_size = 8
+        return {"svc": svc, "hc": hc, "matching": matching,
+                "persistence": persistence, "domains": domains,
+                "scope": scope}
+
+    class Adapter:
+        def __init__(self, svc, consumer):
+            self.svc = svc
+            self.consumer = consumer
+
+        def get_replication_messages(self, shard_id, last, max_tasks=None):
+            return self.svc.get_replication_messages(
+                shard_id, last, cluster=self.consumer,
+                max_tasks=max_tasks)
+
+        def get_workflow_history_raw(self, *a):
+            return self.svc.get_workflow_history_raw(*a)
+
+        def get_replication_backlog(self, shard_id, last):
+            return self.svc.get_replication_backlog(shard_id, last)
+
+        def get_replication_checkpoint(self, *a):
+            return self.svc.get_replication_checkpoint(*a)
+
+    clusters = {n: make_cluster(n) for n in ("active", "standby")}
+    links, processors = {}, {}
+    for consumer, source in (("standby", "active"), ("active", "standby")):
+        wrapped = chaos_link(
+            Adapter(clusters[source]["svc"], consumer),
+            LinkProfile(bytes_per_s=bytes_per_s, max_sleep_s=1.0),
+            seed=7,
+        )
+        links[consumer] = wrapped.link
+        engine = clusters[consumer]["svc"].controller\
+            .get_engine_for_shard(0)
+        transport = AdaptiveTransport(
+            wrapped, source, min_gap_events=1 << 30,
+            metrics=clusters[consumer]["scope"],
+        )
+        rerepl = HistoryRereplicator(
+            wrapped, engine.ndc_replicator, transport=transport,
+            metrics=clusters[consumer]["scope"],
+        )
+        processors[consumer] = ReplicationTaskProcessor(
+            engine.shard, engine.ndc_replicator,
+            ReplicationTaskFetcher(source, wrapped),
+            rereplicator=rerepl,
+            metrics=clusters[consumer]["scope"], transport=transport,
+        )
+        clusters[consumer]["transport"] = transport
+
+    fo_scope = Scope()
+    coordinator = DomainFailoverCoordinator(
+        meta("active"),
+        [ClusterHandle(
+            name=n, metadata=clusters[n]["persistence"].metadata,
+            domains=clusters[n]["domains"], history=clusters[n]["svc"],
+            processors=[processors[n]],
+            transport=clusters[n].get("transport"),
+            registry=clusters[n]["scope"].registry,
+        ) for n in ("active", "standby")],
+        metrics=fo_scope, drain_timeout_s=240.0,
+    )
+    retryable = (LinkPartitionedError,)
+
+    def signal(cluster, wid, name):
+        clusters[cluster]["hc"].signal_workflow_execution(SignalRequest(
+            domain=DOMAIN, workflow_id=wid, signal_name=name,
+            input=b"x" * payload, identity="fo-bench",
+        ))
+
+    try:
+        # backlog on the home region
+        wids = [f"fo-wf-{i}" for i in range(workflows)]
+        for wid in wids:
+            clusters["active"]["hc"].start_workflow_execution(
+                StartWorkflowRequest(
+                    domain=DOMAIN, workflow_id=wid, workflow_type="echo",
+                    task_list="fo-tl", request_id=f"req-{wid}",
+                    execution_start_to_close_timeout_seconds=600,
+                ))
+            for k in range(signals_each):
+                signal("active", wid, f"s{k}")
+
+        # drill 1: managed handover pays the backlog catch-up
+        r_managed = coordinator.managed_handover(DOMAIN, "standby")
+
+        # drill 2: divergence on the new active side, then region loss
+        coordinator.await_convergence(DOMAIN, swallow=retryable)
+        for wid in wids:
+            signal("standby", wid, "orphan")
+        for link in links.values():
+            link.force_partition(True)
+        t_loss = time.monotonic()
+        r_forced = coordinator.forced_failover(
+            DOMAIN, "active", lost_clusters=["standby"]
+        )
+        detect_to_promote_ms = (time.monotonic() - t_loss) * 1000.0
+        for wid in wids:
+            signal("active", wid, "promoted")
+
+        # drill 3: the lost region recovers; storm resolves; failback
+        for link in links.values():
+            link.force_partition(False)
+        t_heal = time.monotonic()
+        r_failback = coordinator.failback(
+            DOMAIN, "standby", swallow=retryable
+        )
+        converged_s = time.monotonic() - t_heal
+        lag_final = max(
+            int(c["transport"].estimator.lag_events)
+            for c in clusters.values() if "transport" in c
+        )
+
+        def row(r, extra=None):
+            d = {
+                "handover_ms": round(r.handover_ms, 2),
+                "unavailability_ms": round(r.unavailability_ms, 2),
+                "lag_at_promote_events": r.replication_lag_at_promote,
+                "conflicts_resolved": r.conflicts_resolved,
+            }
+            if extra:
+                d.update(extra)
+            return d
+
+        unavail = [r_managed.unavailability_ms,
+                   r_forced.unavailability_ms,
+                   r_failback.unavailability_ms]
+        return {
+            "managed": row(r_managed,
+                           {"drained_tasks": r_managed.drained_tasks}),
+            "forced": row(r_forced, {
+                "detect_to_promote_ms": round(detect_to_promote_ms, 2),
+            }),
+            "failback": row(r_failback, {
+                "converged_s": round(converged_s, 3),
+            }),
+            "slo": {
+                "unavailability_ms_bound": unavailability_slo_ms,
+                "unavailability_ms_worst": round(max(unavail), 2),
+                "met": bool(
+                    max(unavail) < unavailability_slo_ms
+                    and r_failback.conflicts_resolved >= 1
+                    and lag_final == 0
+                ),
+            },
+            "conflicts_resolved_total": r_failback.conflicts_resolved,
+            "replication_lag_events_final": lag_final,
+            "link_bytes_per_s": bytes_per_s,
+            "bytes_shipped": sum(l.bytes_total for l in links.values()),
+        }
+    finally:
+        for c in clusters.values():
+            c["svc"].stop()
+            c["matching"].shutdown()
 
 
 def _bench_rebuild_warm(n_hist: int, depth: int, iters: int,
@@ -1539,6 +1778,12 @@ def main() -> None:
         # README "Adaptive geo-replication")
         "replication_lag": dict(lag=dict(
             workflows=12, signals_each=48, bytes_per_s=131072.0)),
+        # domain failover drills: managed handover, forced region-loss
+        # promotion with a conflict storm, failback — per-scenario
+        # unavailability + replication-lag SLOs
+        # (runtime/replication/failover.py; README "Domain failover")
+        "failover_drill": dict(failover=dict(
+            workflows=6, signals_each=24, bytes_per_s=131072.0)),
         # unsampled telemetry cost on the instrumented serving path:
         # the ≤3% guard tests/test_bench_smoke.py pins (utils/tracing)
         "telemetry_overhead": dict(telemetry=dict(
@@ -1575,6 +1820,9 @@ def main() -> None:
             # hydrated event backlog) dominates host-load noise
             "replication_lag": dict(lag=dict(
                 workflows=3, signals_each=20, bytes_per_s=24576.0)),
+            # failover-drill JSON contract at seconds-scale load
+            "failover_drill": dict(failover=dict(
+                workflows=2, signals_each=8, bytes_per_s=131072.0)),
             # the ≤3% unsampled-tracing guard at smoke scale
             "telemetry_overhead": dict(telemetry=dict(
                 calls=4000, rounds=3)),
@@ -1615,6 +1863,13 @@ def main() -> None:
         elif "lag" in cfg:
             try:
                 results[config] = _bench_replication_lag(**cfg["lag"])
+            except Exception as e:
+                results[config] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        elif "failover" in cfg:
+            try:
+                results[config] = _bench_failover_drill(**cfg["failover"])
             except Exception as e:
                 results[config] = {
                     "error": f"{type(e).__name__}: {str(e)[:200]}"

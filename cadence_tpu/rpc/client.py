@@ -125,10 +125,19 @@ class RemoteClusterRPCClient:
         self.consumer_cluster = consumer_cluster
 
     def get_replication_messages(
-        self, shard_id: int, last_retrieved_id: int
+        self, shard_id: int, last_retrieved_id: int, max_tasks=None
     ):
+        if max_tasks is None:
+            # omit the argument entirely: a source host still running
+            # the pre-paging handler signature keeps serving fetches
+            # through a rolling upgrade (the same compatibility rule
+            # ReplicationTaskFetcher.fetch applies)
+            return self._stub.get_replication_messages(
+                shard_id, last_retrieved_id, self.consumer_cluster
+            )
         return self._stub.get_replication_messages(
-            shard_id, last_retrieved_id, self.consumer_cluster
+            shard_id, last_retrieved_id, self.consumer_cluster,
+            max_tasks,
         )
 
     def get_workflow_history_raw(

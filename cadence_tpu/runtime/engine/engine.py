@@ -1185,9 +1185,10 @@ class HistoryEngine:
         """Apply one replicated event batch (HistoryTaskV2)."""
         self.ndc_replicator.apply_events(task)
 
-    def get_replication_messages(self, cluster: str, last_retrieved_id: int):
+    def get_replication_messages(self, cluster: str, last_retrieved_id: int,
+                                 max_tasks=None):
         return self.replicator_queue.get_replication_messages(
-            cluster, last_retrieved_id
+            cluster, last_retrieved_id, max_tasks=max_tasks
         )
 
     def get_replication_backlog(self, last_retrieved_id: int):

@@ -52,13 +52,32 @@ class QueueAckManager:
         # FORCED backwards (rewind / a defer retry firing) so the
         # cursor can't skip the span the ack wants re-read
         self.on_read_rewind: Optional[Callable[[], None]] = None
+        # bumped on every rewind: offers stamped with an older
+        # generation belong to a batch read BEFORE the rewind and must
+        # not land — their add()/set_read_level would re-bump the read
+        # cursor past the rewound span, and the ack sweep would then
+        # jump the hole without the span ever re-processing (the
+        # failover drill caught exactly this: a handover rewind racing
+        # an in-flight read batch lost the handed-over decision task)
+        self._generation = 0
 
-    def add(self, key) -> bool:
+    def generation(self) -> int:
+        """Stamp for a read batch: capture BEFORE reading, pass to
+        add()/set_read_level() — a rewind between read and offer then
+        rejects the stale batch instead of skipping the rewound span."""
+        with self._lock:
+            return self._generation
+
+    def add(self, key, generation: Optional[int] = None) -> bool:
         """Register a read task; False if already outstanding (dup read)
         or already acked (a completed frontier row re-read because queue
         GC deletes exclusively below the ack level). A RETRY entry (its
-        defer delay elapsed) is re-taken."""
+        defer delay elapsed) is re-taken. ``generation`` (from
+        ``generation()`` at read time) rejects offers from a batch read
+        before a rewind."""
         with self._lock:
+            if generation is not None and generation != self._generation:
+                return False
             if key <= self.ack_level:
                 return False
             state = self._outstanding.get(key)
@@ -134,6 +153,9 @@ class QueueAckManager:
             for key in [k for k in self._outstanding if k > level]:
                 del self._outstanding[key]
             self._recompute_retry_min_locked()
+            # invalidate any in-flight read batch: its remaining offers
+            # would re-bump the read cursor over the rewound span
+            self._generation += 1
             if self._update_shard_ack is not None:
                 self._update_shard_ack(level)
                 self._persisted_level = level
@@ -141,8 +163,10 @@ class QueueAckManager:
         if hook is not None:
             hook()
 
-    def set_read_level(self, level) -> None:
+    def set_read_level(self, level, generation: Optional[int] = None) -> None:
         with self._lock:
+            if generation is not None and generation != self._generation:
+                return  # batch read before a rewind: cursor stays put
             self._bump_read_locked(level)
 
     def outstanding(self) -> int:

@@ -337,13 +337,20 @@ class QueueProcessorBase:
         while not self._stopped.is_set():
             if self._paused.is_set():
                 return
+            # generation BEFORE the read: a rewind (failover handover,
+            # reshard fence) landing between this read and the offers
+            # below invalidates the whole batch — otherwise the stale
+            # offers re-bump the read cursor over the rewound span and
+            # the ack sweep jumps it without re-processing a single
+            # task of the handed-over span
+            gen = self.ack.generation()
             batch = self._read_batch(self.ack.read_level, self._batch_size)
             if not batch:
                 return
             for task in batch:
                 key = self._task_key(task)
-                if not self.ack.add(key):
-                    continue  # already outstanding
+                if not self.ack.add(key, generation=gen):
+                    continue  # already outstanding (or batch rewound)
                 self._pool.submit(self._run_task, task, key)
             # advance the read cursor past everything READ, including
             # keys add() rejected (parked/running/done): add() only
@@ -352,7 +359,7 @@ class QueueProcessorBase:
             # identical rows forever and never leave this loop (no ack
             # sweep, 100% CPU). Parked tasks are still re-read later —
             # their retry timers rewind the read level to the ack level.
-            self.ack.set_read_level(self._task_key(batch[-1]))
+            self.ack.set_read_level(self._task_key(batch[-1]), generation=gen)
             if len(batch) < self._batch_size:
                 return
 

@@ -139,30 +139,57 @@ class QueueGC:
                 self._gclog.exception("queue GC collect failed")
 
 
+def _fv_increment(engine) -> int:
+    """The topology's failover-version increment (0 when the engine
+    carries no cluster metadata — the allocator then arms handovers
+    from stood-by membership only)."""
+    cm = getattr(engine, "cluster_metadata", None)
+    return cm.failover_version_increment if cm is not None else 0
+
+
 class _StandbyAllocator:
     """Owns a task iff its domain is ACTIVE in ``cluster`` (i.e. this
     cluster stands by for it)."""
 
     def __init__(self, domains, cluster: str,
-                 local_cluster: str = "") -> None:
+                 local_cluster: str = "",
+                 failover_version_increment: int = 0) -> None:
         self.domains = domains
         self.cluster = cluster
         self.local_cluster = local_cluster
+        # cycle detection: fv >= increment means the domain has failed
+        # over at least once (registration versions live in cycle 0) —
+        # the arm condition for a plane that observes a flip-to-local
+        # WITHOUT ever having stood by (its first read of the span can
+        # race the flip; see classify)
+        self._increment = failover_version_increment
         # domains this allocator has stood by for — a later flip to
         # locally-active means a failover whose held span must hand
         # over to the active processor
         self._stood_by: set = set()
         # newest failover version observed per domain: a worker that
-        # read the record BEFORE a failover must not re-arm _stood_by
+        # read the record BEFORE a failover must not re-arm the claim
         # AFTER another worker consumed the handover (the stale re-add
         # would rewind the active cursor a second time)
         self._seen_version: dict = {}
+        # failover version whose handover this plane already claimed:
+        # the claim is once PER OBSERVED FAILOVER (keyed by version),
+        # not per stood-by membership — a plane whose first read of a
+        # task span lands AFTER the flip never stood by for it, yet the
+        # active processor may have skipped that span as standby-owned
+        # while the domain record still named the old owner. Without a
+        # version-keyed claim that span is silently discharged by both
+        # planes and its tasks are lost (the failover drill caught the
+        # race: the handed-over decision task vanished and the
+        # workflow never completed on the new active side).
+        self._claimed_fv: dict = {}
         self._claim_lock = threading.Lock()
 
     def classify(self, domain_id: str) -> str:
-        """'owned' (verify here) | 'handover' (domain we stood by for
-        just became locally active — give the task to the active
-        plane, ONCE per failover observation) | 'other' (not ours)."""
+        """'owned' (verify here) | 'handover' (domain just became
+        locally active via a failover this plane has not handed over
+        yet — give the span to the active plane, ONCE per failover
+        observation) | 'other' (not ours)."""
         try:
             rec = self.domains.get_by_id(domain_id)
         except Exception:
@@ -178,25 +205,39 @@ class _StandbyAllocator:
             if active == self.cluster:
                 self._stood_by.add(domain_id)
                 return "owned"
-            if domain_id in self._stood_by and active == self.local_cluster:
-                return "handover"
+            if active == self.local_cluster:
+                ever_failed_over = (
+                    self._increment > 0 and fv >= self._increment
+                )
+                if (domain_id in self._stood_by or ever_failed_over) \
+                        and self._claimed_fv.get(domain_id) != fv:
+                    return "handover"
             return "other"
 
     def claim_handover(self, domain_id: str) -> bool:
         """Compare-and-consume: exactly ONE concurrent caller wins the
-        handover for a domain (two pool workers can both classify
-        'handover' for the same failover). Without consumption, every
-        future task of the now-local domain would rewind the active
-        cursor forever."""
+        handover for a domain's observed failover version (two pool
+        workers can both classify 'handover' for the same failover).
+        Without consumption, every future task of the now-local domain
+        would rewind the active cursor forever."""
         with self._claim_lock:
-            if domain_id in self._stood_by:
-                self._stood_by.discard(domain_id)
-                return True
-            return False
+            fv = self._seen_version.get(domain_id)
+            armed = domain_id in self._stood_by or (
+                self._increment > 0
+                and fv is not None
+                and fv >= self._increment
+            )
+            if not armed or fv is None \
+                    or self._claimed_fv.get(domain_id) == fv:
+                return False
+            self._claimed_fv[domain_id] = fv
+            self._stood_by.discard(domain_id)
+            return True
 
     def rearm_handover(self, domain_id: str) -> None:
         """Give the claim back (the handover callback failed)."""
         with self._claim_lock:
+            self._claimed_fv.pop(domain_id, None)
             self._stood_by.add(domain_id)
 
 
@@ -235,7 +276,8 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
         # rewind target has already moved past the held span)
         self._on_handover = on_handover
         self._allocator = _StandbyAllocator(
-            engine.domains, cluster, local_cluster=local_cluster
+            engine.domains, cluster, local_cluster=local_cluster,
+            failover_version_increment=_fv_increment(engine),
         )
         shard.ensure_cluster_ack_levels(cluster)
         ack = QueueAckManager(
@@ -443,7 +485,8 @@ class TimerQueueStandbyProcessor:
         )
         shard.add_remote_time_listener(self._on_remote_time)
         self._allocator = _StandbyAllocator(
-            engine.domains, cluster, local_cluster=local_cluster
+            engine.domains, cluster, local_cluster=local_cluster,
+            failover_version_increment=_fv_increment(engine),
         )
         self._stopped = threading.Event()
         self._paused = threading.Event()  # reshard fence: intake off

@@ -7,6 +7,12 @@ nDCStateRebuilder / nDCEventsReapplier / nDCTransactionMgr (apply),
 common/xdc/historyRereplicator.go (gap fill).
 """
 
+from .failover import (
+    ClusterHandle,
+    DomainFailoverCoordinator,
+    FailoverDrillError,
+    FailoverReport,
+)
 from .messages import (
     HistoryTaskV2,
     ReplicationMessages,
@@ -26,6 +32,10 @@ from .transport import (
 )
 
 __all__ = [
+    "ClusterHandle",
+    "DomainFailoverCoordinator",
+    "FailoverDrillError",
+    "FailoverReport",
     "HistoryTaskV2",
     "ReplicationMessages",
     "RetryTaskV2Error",

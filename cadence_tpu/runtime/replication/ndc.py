@@ -93,6 +93,12 @@ class NDCHistoryReplicator:
         # transient rebuilder it builds must emit events_replayed_saved
         # into the same registry as the engine-wired one)
         self._raw_metrics = metrics
+        from cadence_tpu.utils.metrics import NOOP
+
+        # conflict-resolution observability: the failover drill reports
+        # (replication/failover.py) read these counters as "how many
+        # divergent-branch storms did the heal actually resolve"
+        self._metrics = (metrics or NOOP).tagged(layer="replication")
         self._transient_snapshots = None
 
     def _resolve_domain(self, name: str) -> str:
@@ -238,6 +244,7 @@ class NDCHistoryReplicator:
             rebuilt.execution_info.run_id = task.run_id
             rebuilt.execution_info.workflow_id = task.workflow_id
             self._apply_to_current(ctx, rebuilt, task, target_vh)
+            self._metrics.inc("replication_conflicts_resolved")
 
     # -- creation path (nDCTransactionMgrForNewWorkflow) ---------------
 
@@ -445,6 +452,7 @@ class NDCHistoryReplicator:
         new_vh = VersionHistory(
             branch_token=forked.to_json().encode(), items=items
         )
+        self._metrics.inc("replication_branches_forked")
         prior_current = local.current_index
         changed, new_index = local.add_version_history(new_vh)
         if changed:
@@ -516,6 +524,7 @@ class NDCHistoryReplicator:
         rebuilt.execution_info.run_id = task.run_id
         rebuilt.execution_info.workflow_id = task.workflow_id
         self._apply_to_current(ctx, rebuilt, task, target_vh)
+        self._metrics.inc("replication_conflicts_resolved")
 
     def _backfill_branch(
         self, ctx, ms: MutableState, task: HistoryTaskV2, branch_index: int
@@ -524,6 +533,16 @@ class NDCHistoryReplicator:
         history bookkeeping without touching workflow state."""
         local = ms.version_histories
         vh = local.get_version_history(branch_index)
+        if all(
+            vh.contains_item(VersionHistoryItem(e.event_id, e.version))
+            for e in task.events
+        ):
+            # at-least-once re-fetch of an already-archived batch: the
+            # bookkeeping would reject the replayed item ids, and a
+            # second signal reapplication would mint divergent bytes —
+            # the duplicate is dropped whole, like the current-branch
+            # dedup above
+            return
         branch = BranchToken.from_json(vh.branch_token.decode())
         self.shard.persistence.history.append_history_nodes(
             branch, task.events, transaction_id=self.shard.next_task_id()
@@ -536,6 +555,10 @@ class NDCHistoryReplicator:
         )
         ctx._ms = ms
         ctx._condition = ms.next_event_id
+        # the losing side of a version conflict is resolved here: its
+        # events are archived on the stale branch, the winner keeps
+        # current — count it like the rebuild-win path does
+        self._metrics.inc("replication_conflicts_resolved")
         # signals on the stale branch still matter to the live run
         if self._is_active_locally(task.domain_id):
             self._reapply_signals(ctx, ms, task.events)
@@ -702,9 +725,20 @@ class NDCHistoryReplicator:
             self._task_notifier()
         if snapshot.timer_tasks:
             self._timer_notifier()
+        from cadence_tpu.core.enums import CloseStatus
+
         return {
             "covered_through": snap_tip,
             "backfill_from": backfill_from,
+            # a snapshot-covered run that closed ContinuedAsNew has a
+            # chain successor whose first batch rode the predecessor's
+            # replication task — which this fast-forward bypassed. The
+            # caller (rereplicator) must heal the successor explicitly
+            # or the chain's new run never materializes locally.
+            "continued_as_new": (
+                rebuilt.execution_info.close_status
+                == CloseStatus.ContinuedAsNew
+            ),
         }
 
     def backfill_history(
@@ -762,6 +796,7 @@ class NDCHistoryReplicator:
                 a.get("identity", ""), now,
             )
         result = txn.close()
+        repl = []
         if result.events:
             branch = BranchToken.from_json(
                 ms.execution_info.branch_token.decode()
@@ -770,10 +805,25 @@ class NDCHistoryReplicator:
                 branch, result.events,
                 transaction_id=self.shard.next_task_id(),
             )
+            # reapplication is an ACTIVE-side mint (this cluster owns
+            # the domain): the reapplied events must ship to the peers
+            # like any engine transaction, or the recovered region
+            # completes the workflow without them and the clusters
+            # diverge (the failover drill caught exactly this)
+            if ms.version_histories is not None:
+                from cadence_tpu.core.tasks import ReplicationTask
+
+                repl = [ReplicationTask(
+                    first_event_id=result.events[0].event_id,
+                    next_event_id=result.events[-1].event_id + 1,
+                    version=result.events[0].version,
+                    branch_token=ms.execution_info.branch_token,
+                )]
         # with a decision in flight the signals land in buffered_events;
         # they reach history when the decision completes
         snapshot = self._snapshot(
-            ms, result.transfer_tasks, result.timer_tasks
+            ms, result.transfer_tasks, result.timer_tasks,
+            replication=repl,
         )
         self.shard.persistence.execution.update_workflow_execution(
             self.shard.shard_id, self.shard.range_id, ctx.condition, snapshot,
@@ -783,7 +833,8 @@ class NDCHistoryReplicator:
     # -- persistence helpers -------------------------------------------
 
     def _snapshot(
-        self, ms: MutableState, transfer, timer, zombie: bool = False
+        self, ms: MutableState, transfer, timer, zombie: bool = False,
+        replication=(),
     ) -> WorkflowSnapshot:
         if zombie:
             # a ZOMBIE run is deliberately not current: enqueueing live
@@ -792,14 +843,15 @@ class NDCHistoryReplicator:
             # writes carry no task generation)
             transfer, timer = [], []
         ei = ms.execution_info
-        for t in list(transfer) + list(timer):
+        replication = list(replication)
+        for t in list(transfer) + list(timer) + replication:
             if not t.domain_id:
                 t.domain_id = ei.domain_id
             if not t.workflow_id:
                 t.workflow_id = ei.workflow_id
             if not t.run_id:
                 t.run_id = ei.run_id
-        self.shard.assign_task_ids(transfer, timer)
+        self.shard.assign_task_ids(transfer, timer, replication)
         return WorkflowSnapshot(
             domain_id=ei.domain_id,
             workflow_id=ei.workflow_id,
@@ -809,6 +861,7 @@ class NDCHistoryReplicator:
             last_write_version=ms.current_version,
             transfer_tasks=list(transfer),
             timer_tasks=list(timer),
+            replication_tasks=replication,
         )
 
     def _stage_new_run(

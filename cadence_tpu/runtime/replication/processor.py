@@ -34,7 +34,8 @@ class RemoteClusterClient:
     gRPC stub across hosts)."""
 
     def get_replication_messages(
-        self, shard_id: int, last_retrieved_id: int
+        self, shard_id: int, last_retrieved_id: int,
+        max_tasks: Optional[int] = None,
     ) -> ReplicationMessages:
         raise NotImplementedError
 
@@ -66,13 +67,20 @@ class ReplicationTaskFetcher:
         with self._lock:
             return self._cursor.get(shard_id, 0)
 
-    def fetch(self, shard_id: int) -> ReplicationMessages:
+    def fetch(self, shard_id: int,
+              max_tasks: Optional[int] = None) -> ReplicationMessages:
         """Read past the committed cursor WITHOUT advancing it — the
         processor commits only after tasks apply, so a failed apply is
         re-fetched (at-least-once, matching the reference's
-        lastProcessedMessageId ack)."""
+        lastProcessedMessageId ack). ``max_tasks`` caps the emit page
+        (the adaptive transport's per-link paging); None keeps the
+        emit side's static default."""
+        if max_tasks is None:
+            return self.client.get_replication_messages(
+                shard_id, self.last_retrieved(shard_id)
+            )
         return self.client.get_replication_messages(
-            shard_id, self.last_retrieved(shard_id)
+            shard_id, self.last_retrieved(shard_id), max_tasks=max_tasks
         )
 
     def commit(self, shard_id: int, applied_through: int) -> None:
@@ -183,7 +191,13 @@ class ReplicationTaskProcessor:
 
     def _process_cycle(self) -> int:
         t0 = time.monotonic()
-        msgs = self.fetcher.fetch(self.shard.shard_id)
+        # per-link dynamic paging: a throttled link fetches pages sized
+        # to its measured budget instead of the emit side's static page
+        page_hint = (
+            self.transport.page_size()
+            if self.transport is not None else None
+        )
+        msgs = self.fetcher.fetch(self.shard.shard_id, max_tasks=page_hint)
         if self.transport is not None:
             # the fetch IS the link probe: bytes + wall time feed the
             # bandwidth/bytes-per-event EWMAs the mode controller reads

@@ -166,19 +166,26 @@ class ReplicatorQueueProcessor:
     # -- pull API ------------------------------------------------------
 
     def get_replication_messages(
-        self, cluster: str, last_retrieved_id: int
+        self, cluster: str, last_retrieved_id: int,
+        max_tasks: Optional[int] = None,
     ) -> ReplicationMessages:
         """Serve tasks after ``last_retrieved_id``; completing everything
         the remote has already confirmed (replicatorQueueProcessor.go
-        getTasks: ack then read)."""
+        getTasks: ack then read). ``max_tasks`` lets a bandwidth-aware
+        consumer shrink the page below the static ``batch_size`` — a
+        throttled link pulls pages its budget can afford instead of
+        timing out on one giant hydrated transfer."""
         if self._fault_hook is not None:
             self._fault_hook("get_replication_messages", self.shard.shard_id)
         self.ack(cluster, last_retrieved_id)
+        page = self.batch_size
+        if max_tasks is not None:
+            page = max(1, min(page, int(max_tasks)))
         tasks = self.shard.persistence.execution.get_replication_tasks(
-            self.shard.shard_id, last_retrieved_id, self.batch_size + 1
+            self.shard.shard_id, last_retrieved_id, page + 1
         )
-        has_more = len(tasks) > self.batch_size
-        tasks = tasks[: self.batch_size]
+        has_more = len(tasks) > page
+        tasks = tasks[:page]
         out: List[HistoryTaskV2] = []
         last_id = last_retrieved_id
         for t in tasks:

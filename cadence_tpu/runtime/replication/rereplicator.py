@@ -58,13 +58,14 @@ class HistoryRereplicator:
             self.transport is not None
             and self.transport.controller.decide(gap) == MODE_SNAPSHOT
         ):
+            recovered = None
             try:
-                if self._snapshot_recover(err):
-                    return 0
-                self._metrics.inc("replication_snapshot_fallbacks")
-                tracing.annotate(
-                    f"snapshot_fallback wf={err.workflow_id}"
-                )
+                recovered = self._snapshot_recover(err)
+                if recovered is None:
+                    self._metrics.inc("replication_snapshot_fallbacks")
+                    tracing.annotate(
+                        f"snapshot_fallback wf={err.workflow_id}"
+                    )
             except Exception:
                 # torn snapshot transfer / partitioned link mid-blob:
                 # the event path below re-fetches through the same
@@ -78,6 +79,18 @@ class HistoryRereplicator:
                     "shipping",
                     workflow=err.workflow_id, run=err.run_id,
                 )
+            if recovered is not None:
+                # the chain-successor heal runs OUTSIDE the fallback
+                # guard above: a failure here must propagate so the
+                # caller holds its cursor and retries — falling back to
+                # the event path for the PREDECESSOR run would read as
+                # healed while the successor's first batch stays lost
+                if recovered.get("continued_as_new"):
+                    self._heal_chain_successor(
+                        err.domain_id, err.workflow_id, err.run_id,
+                        tip_event_id=recovered["covered_through"],
+                    )
+                return 0
         start = err.start_event_id + 1 if err.start_event_id else 1
         end = err.end_event_id or (1 << 60)
         if self.transport is not None:
@@ -88,26 +101,39 @@ class HistoryRereplicator:
             batches, items = self.remote.get_workflow_history_raw(
                 err.domain_id, err.workflow_id, err.run_id, start, end
             )
-        return apply_raw_history(
+        applied = apply_raw_history(
             self.replicator, err.domain_id, err.workflow_id, err.run_id,
             batches, items,
         )
+        # the raw event heal has the same chain blind spot as snapshot
+        # shipping: synthetic tasks carry no new_run_events, so a
+        # healed run that closed ContinuedAsNew leaves its successor's
+        # first batch unapplied — walk the chain explicitly
+        succ = _chain_successor_of(batches)
+        if succ:
+            self._heal_chain_successor(
+                err.domain_id, err.workflow_id, err.run_id,
+                successor_run_id=succ,
+            )
+        return applied
 
     # -- snapshot recovery --------------------------------------------
 
-    def _snapshot_recover(self, err: RetryTaskV2Error) -> bool:
+    def _snapshot_recover(self, err: RetryTaskV2Error):
+        """Returns apply_state_snapshot's result record on success, or
+        None when the gap must heal through the event path."""
         got = self.transport.fetch_snapshot(
             err.domain_id, err.workflow_id, err.run_id
         )
         if got is None:
-            return False
+            return None
         ckpt, nbytes = got
         t0 = time.monotonic()
         res = self.replicator.apply_state_snapshot(
             err.domain_id, err.workflow_id, err.run_id, ckpt
         )
         if res is None:
-            return False
+            return None
         self.transport.estimator.observe_snapshot(
             nbytes, time.monotonic() - t0
         )
@@ -122,7 +148,131 @@ class HistoryRereplicator:
                 err.domain_id, err.workflow_id, err.run_id,
                 res["backfill_from"], res["covered_through"],
             )
-        return True
+        return res
+
+    # -- continue-as-new chain walk -----------------------------------
+
+    _CHAIN_HEAL_MAX = 16
+
+    def _fetch_raw(self, domain_id: str, workflow_id: str, run_id: str,
+                   start: int, end: int):
+        if self.transport is not None:
+            return self.transport.fetch_raw_history(
+                domain_id, workflow_id, run_id, start, end
+            )
+        return self.remote.get_workflow_history_raw(
+            domain_id, workflow_id, run_id, start, end
+        )
+
+    def _fetch_tip_event(self, domain_id: str, workflow_id: str,
+                         run_id: str, tip: int):
+        """The run's final event via node-aligned raw reads: history
+        nodes key on their batch's FIRST event id, so a [tip, tip+1)
+        read misses a tail that sits inside a wider batch — widen the
+        window geometrically until the tip lands (bounded by the full
+        history)."""
+        lo = tip
+        while True:
+            batches, _ = self._fetch_raw(
+                domain_id, workflow_id, run_id, lo, tip + 1
+            )
+            for b in batches:
+                for e in b:
+                    if e.event_id == tip:
+                        return e
+            if lo <= 1:
+                return None
+            lo = max(1, lo - 16 * max(1, tip + 1 - lo))
+
+    def _heal_chain_successor(
+        self, domain_id: str, workflow_id: str, run_id: str,
+        tip_event_id: int = 0, successor_run_id: str = "",
+    ) -> int:
+        """Walk a continue-as-new chain forward from a healed run and
+        materialize every successor the fast-forward bypassed.
+
+        A chain run's FIRST batch rides its predecessor's replication
+        task as ``new_run_events`` — a catch-up that heals the
+        predecessor by snapshot (or raw-history fetch) and fast-forwards
+        the cursor past those tasks loses the successor entirely: it has
+        no replication tasks of its own until a second batch exists, so
+        no later cycle will ever surface it. When the successor id is
+        unknown (snapshot path: the covered events are backfill debt,
+        not yet local) the predecessor's tip event is fetched remotely
+        — one event — to read ``new_execution_run_id``. Each successor
+        heals snapshot-first when the transport prefers it, else by raw
+        history from event 1; the walk continues while the healed run
+        itself continued-as-new (bounded, loudly, at 16 hops). Failures
+        raise: the caller must hold its cursor and retry rather than
+        mark the span healed with a chain run missing."""
+        healed = 0
+        cur_run, cur_tip, next_run = run_id, tip_event_id, successor_run_id
+        seen = {run_id}
+        for _ in range(self._CHAIN_HEAL_MAX):
+            if not next_run:
+                # read the predecessor's final event for the successor id
+                tail = self._fetch_tip_event(
+                    domain_id, workflow_id, cur_run, cur_tip
+                )
+                if tail is None:
+                    break
+                next_run = tail.attributes.get(
+                    "new_execution_run_id", ""
+                )
+            if not next_run or next_run in seen:
+                break
+            seen.add(next_run)
+            res = None
+            if self.transport is not None:
+                # unknown gap for a run we may not have at all: let the
+                # controller's current mode decide, exactly like the
+                # predecessor's heal did
+                try:
+                    res = self._snapshot_recover(RetryTaskV2Error(
+                        "chain successor heal",
+                        domain_id=domain_id, workflow_id=workflow_id,
+                        run_id=next_run, start_event_id=0, end_event_id=0,
+                    )) if self.transport.controller.mode == MODE_SNAPSHOT \
+                        else None
+                except Exception:
+                    res = None  # raw-history heal below stays correct
+            if res is not None:
+                healed += 1
+                self._metrics.inc("replication_chain_heals")
+                cur_run, cur_tip = next_run, res["covered_through"]
+                next_run = ""
+                if res.get("continued_as_new"):
+                    continue
+                break
+            batches, items = self._fetch_raw(
+                domain_id, workflow_id, next_run, 1, 1 << 60
+            )
+            applied = apply_raw_history(
+                self.replicator, domain_id, workflow_id, next_run,
+                batches, items,
+            )
+            if applied == 0 and not any(batches):
+                break  # source knows no such run: chain ends here
+            healed += 1
+            self._metrics.inc("replication_chain_heals")
+            succ = _chain_successor_of(batches)
+            if not succ:
+                break
+            cur_run, next_run = next_run, succ
+        else:
+            # raising (not warning) keeps the caller's cursor held, so
+            # a chain deeper than the hop bound converges through the
+            # regular event stream instead — the held cursor re-fetches
+            # the original tasks, whose new_run_events create each
+            # successor page by page. Silent truncation here would lose
+            # every run past the bound forever (they have no
+            # replication tasks of their own to ever surface again).
+            raise RuntimeError(
+                f"continue-as-new chain for {workflow_id!r} exceeds "
+                f"{self._CHAIN_HEAL_MAX} hops; holding the cursor so "
+                "the event stream heals the remainder"
+            )
+        return healed
 
     def backfill(self, domain_id: str, workflow_id: str, run_id: str,
                  from_event_id: int, through_event_id: int) -> int:
@@ -147,6 +297,22 @@ class HistoryRereplicator:
         if applied:
             self._metrics.inc("replication_backfill_events", applied)
         return applied
+
+
+def _chain_successor_of(batches) -> str:
+    """The continue-as-new successor run id a healed history names in
+    its final event, or "" when the run didn't continue."""
+    from cadence_tpu.core.enums import EventType
+
+    tail = None
+    for b in batches:
+        if b:
+            tail = b[-1]
+    if tail is None:
+        return ""
+    if tail.event_type != EventType.WorkflowExecutionContinuedAsNew:
+        return ""
+    return tail.attributes.get("new_execution_run_id", "")
 
 
 def apply_raw_history(

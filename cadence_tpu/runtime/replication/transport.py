@@ -152,6 +152,7 @@ class LinkEstimator:
     # an unobserved link never prefers snapshots (bandwidth None gates
     # the controller anyway)
     BYTES_PER_EVENT_PRIOR = 512.0
+    BYTES_PER_TASK_PRIOR = 2048.0
     SNAPSHOT_BYTES_PRIOR = 64 * 1024.0
     SNAPSHOT_APPLY_S_PRIOR = 0.05
 
@@ -162,6 +163,7 @@ class LinkEstimator:
         self._lock = threading.Lock()
         self._bandwidth_bps: Optional[float] = None
         self._bytes_per_event: Optional[float] = None
+        self._bytes_per_task: Optional[float] = None
         self._snapshot_bytes: Optional[float] = None
         self._snapshot_apply_s: Optional[float] = None
         self.bytes_total = 0
@@ -176,7 +178,7 @@ class LinkEstimator:
     # -- observations --------------------------------------------------
 
     def observe_transfer(self, nbytes: int, seconds: float,
-                         n_events: int = 0) -> None:
+                         n_events: int = 0, n_tasks: int = 0) -> None:
         """One completed transfer on the link (any payload kind)."""
         with self._lock:
             self.bytes_total += max(0, nbytes)
@@ -187,6 +189,10 @@ class LinkEstimator:
             if n_events > 0 and nbytes > 0:
                 self._bytes_per_event = self._ewma(
                     self._bytes_per_event, nbytes / n_events
+                )
+            if n_tasks > 0 and nbytes > 0:
+                self._bytes_per_task = self._ewma(
+                    self._bytes_per_task, nbytes / n_tasks
                 )
 
     def observe_snapshot(self, nbytes: int, apply_seconds: float) -> None:
@@ -215,6 +221,10 @@ class LinkEstimator:
         with self._lock:
             return self._bytes_per_event or self.BYTES_PER_EVENT_PRIOR
 
+    def bytes_per_task(self) -> float:
+        with self._lock:
+            return self._bytes_per_task or self.BYTES_PER_TASK_PRIOR
+
     def snapshot_bytes(self) -> float:
         with self._lock:
             return self._snapshot_bytes or self.SNAPSHOT_BYTES_PRIOR
@@ -228,6 +238,7 @@ class LinkEstimator:
             return {
                 "bandwidth_bps": self._bandwidth_bps,
                 "bytes_per_event": self._bytes_per_event,
+                "bytes_per_task": self._bytes_per_task,
                 "snapshot_bytes": self._snapshot_bytes,
                 "snapshot_apply_s": self._snapshot_apply_s,
                 "bytes_total": self.bytes_total,
@@ -382,10 +393,36 @@ class AdaptiveTransport:
         call; the transport does the bookkeeping)."""
         n_events = sum(len(t.events) for t in msgs.tasks)
         nbytes = wire_size(msgs)
-        self.estimator.observe_transfer(nbytes, seconds, n_events=n_events)
+        self.estimator.observe_transfer(
+            nbytes, seconds, n_events=n_events, n_tasks=len(msgs.tasks)
+        )
         self._metrics.tagged(mode=MODE_EVENTS).inc(
             "replication_bytes_shipped", nbytes
         )
+
+    # -- dynamic fetch paging -----------------------------------------
+
+    # one fetch should occupy the link for about this long; on a
+    # throttled link the page shrinks accordingly instead of one huge
+    # hydrated page timing out (or sleeping the chaos link for minutes)
+    FETCH_TARGET_S = 2.0
+    MIN_FETCH_PAGE = 4
+    MAX_FETCH_PAGE = 512
+
+    def page_size(self) -> Optional[int]:
+        """Per-link emit-page cap for the next fetch, from the measured
+        bandwidth and bytes-per-task EWMAs: the task count whose
+        hydrated bytes fit ``FETCH_TARGET_S`` of link time, clamped to
+        [MIN_FETCH_PAGE, MAX_FETCH_PAGE]. None before the first
+        bandwidth sample — the emit side's static default applies (an
+        unmeasured link is not presumed slow)."""
+        bw = self.estimator.bandwidth_bps()
+        if bw is None or bw <= 0:
+            return None
+        tasks = int(bw * self.FETCH_TARGET_S / self.estimator.bytes_per_task())
+        page = max(self.MIN_FETCH_PAGE, min(self.MAX_FETCH_PAGE, tasks))
+        self._metrics.gauge("replication_fetch_page_limit", page)
+        return page
 
     def fetch_backlog(self, shard_id: int,
                       last_retrieved_id: int) -> Optional[dict]:

@@ -331,10 +331,13 @@ class HistoryService:
         engine.replicate_events_v2(task)
 
     def get_replication_messages(
-        self, shard_id: int, last_retrieved_id: int, cluster: str
+        self, shard_id: int, last_retrieved_id: int, cluster: str,
+        max_tasks=None,
     ):
         engine = self.controller.get_engine_for_shard(shard_id)
-        return engine.get_replication_messages(cluster, last_retrieved_id)
+        return engine.get_replication_messages(
+            cluster, last_retrieved_id, max_tasks=max_tasks
+        )
 
     def get_workflow_history_raw(
         self, domain_id: str, workflow_id: str, run_id: str,

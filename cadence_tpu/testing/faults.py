@@ -376,11 +376,25 @@ class SimulatedLink:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._transfers = 0
+        self._forced_partition = False
         self.bytes_total = 0
         self.partitioned_calls = 0
         self.slept_s = 0.0
 
+    def force_partition(self, on: bool) -> None:
+        """Manual region-loss switch for drill choreography ("the WAN
+        segment is down NOW, heal it THERE") — deterministic as long as
+        the caller toggles it at deterministic points, unlike wall-time
+        windows and unconstrained by the transfer index the profile
+        windows key on. Forced-partitioned transfers still consume
+        their transfer index and RNG draw, so toggling never shifts
+        later draws."""
+        with self._lock:
+            self._forced_partition = bool(on)
+
     def _partitioned(self, index: int) -> bool:
+        if self._forced_partition:
+            return True
         return any(a <= index < b for a, b in self.profile.partitions)
 
     def transfer(self, nbytes: int) -> float:
@@ -445,9 +459,12 @@ class ChaosLinkClient:
         self.link.transfer(wire_size(payload))
         return payload
 
-    def get_replication_messages(self, shard_id, last_retrieved_id):
+    def get_replication_messages(self, shard_id, last_retrieved_id,
+                                 max_tasks=None):
         return self._shipped(
-            self._base.get_replication_messages(shard_id, last_retrieved_id)
+            self._base.get_replication_messages(
+                shard_id, last_retrieved_id, max_tasks=max_tasks
+            )
         )
 
     def get_workflow_history_raw(self, domain_id, workflow_id, run_id,

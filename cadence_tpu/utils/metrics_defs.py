@@ -115,6 +115,21 @@ REPLICATION_METRICS = (
     "replication_bytes_shipped",
     "replication_snapshots_shipped", "replication_snapshot_fallbacks",
     "replication_backfill_events", "replication_pump_backoffs",
+    # NDC conflict-resolution observability (runtime/replication/ndc.py):
+    # branches_forked counts divergence points materialized (a fork at
+    # the LCA), conflicts_resolved counts resolutions — the incoming
+    # higher-version branch winning a rebuild-and-apply (inline or via
+    # the batched drain) or a stale lower-version batch archived onto a
+    # non-current branch. The failover drill reports read the counter
+    # as "how big was the version-branch storm this failover caused".
+    "replication_branches_forked", "replication_conflicts_resolved",
+    # continue-as-new chain successors materialized by a catch-up heal
+    # (rereplicator.py — the successor's first batch rides the
+    # predecessor's task, which snapshot/raw-history catch-ups bypass)
+    "replication_chain_heals",
+    # dynamic per-link fetch paging (transport.page_size): the emit-page
+    # cap last derived from the bandwidth/bytes-per-task EWMAs
+    "replication_fetch_page_limit",
 )
 # chaos/fault-injection plane (testing/faults.py): every injected fault
 # increments faults_injected under tags (layer=fault_injection,
@@ -159,6 +174,26 @@ RESHARD_METRICS = (
 # (service=history, shard=...): today just the start rate; grows with
 # the serving-path work (METRIC-UNDECLARED keeps this list honest).
 ENGINE_METRICS = ("workflow_started",)
+
+# domain failover drills (runtime/replication/failover.py), emitted by
+# the coordinator under tags (layer=failover, kind=managed|forced|
+# failback, domain=...): domain_failovers counts completed drills,
+# failover_handover_ms times each drill end-to-end (histogram),
+# failover_unavailability_ms times the flip-start → new-active-observes
+# window (the span where neither side safely mints decisions),
+# failover_replication_lag_at_promote gauges the events known
+# outstanding on the inbound link when ownership flipped (0 for a
+# drained managed handover; the dead link's last view for a forced
+# promotion), and failover_conflicts_resolved accumulates the NDC
+# version-branch resolutions each drill's heal phase caused (the
+# registry delta of replication_conflicts_resolved across the drill).
+FAILOVER_METRICS = (
+    "domain_failovers",
+    "failover_handover_ms",
+    "failover_unavailability_ms",
+    "failover_replication_lag_at_promote",
+    "failover_conflicts_resolved",
+)
 
 # device-step kernel telemetry (ops/dispatch.py), emitted by the
 # dispatcher per staged/replayed batch under tags (layer=device,

@@ -24,6 +24,13 @@
 #       # replay with the instrumentation installed, and a refreshed
 #       # build/lock_witness.json for scripts/run_lint.sh
 #       # --emit-lock-graph
+#   CHAOS_FAILOVER=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # domain failover drill sweep (tests/test_failover_drills.py):
+#       # managed handover with zero lost progress, forced failover on
+#       # region loss with a conflict-resolution storm, and failback —
+#       # every seed re-proves the forced+failback choreography
+#       # byte-identical to its fault-free baseline under the >=10%
+#       # write-fault storm, with conflicts_resolved >= 1
 #
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
@@ -41,6 +48,9 @@ fi
 if [[ -n "${CHAOS_SANITIZE:-}" ]]; then
     FILTER=(-k TestSanitizedChaos)
 fi
+if [[ -n "${CHAOS_FAILOVER:-}" ]]; then
+    FILTER=(-k "TestFailoverManagedHandover or TestFailoverRegionLossStorm")
+fi
 
 run_one() {
     local seed="$1"; shift
@@ -48,6 +58,7 @@ run_one() {
     # --runslow: the sweep runs the FULL family, including the
     # slow-marked members tier-1 leaves out for wall-clock budget
     CHAOS_SEED="${seed}" python -m pytest tests/test_chaos_recovery.py \
+        tests/test_failover_drills.py \
         -q -m chaos --runslow -p no:cacheprovider \
         ${FILTER[@]+"${FILTER[@]}"} "$@"
 }

@@ -42,8 +42,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "chaos: fault-injection recovery suite (tests/test_chaos_recovery"
-        ".py); runs in tier-1, selectable via -m chaos "
-        "(scripts/run_chaos.sh seeds CHAOS_SEED sweeps)",
+        ".py + tests/test_failover_drills.py); runs in tier-1, selectable "
+        "via -m chaos (scripts/run_chaos.sh seeds CHAOS_SEED sweeps)",
     )
 
 

@@ -120,6 +120,25 @@ def test_smoke_emits_one_json_record():
     assert lag["adaptive"]["mode_switches"] >= 1, lag["adaptive"]
     assert lag["adaptive"]["catch_up_s"] <= \
         lag["events"]["catch_up_s"] * 1.25, lag
+    # the failover-drill contract (ISSUE 13): all three drill shapes
+    # report their unavailability window + replication lag at promote
+    # time, the forced+failback sequence resolves a real version-branch
+    # conflict storm, replication lag drains to zero after the final
+    # convergence, and the worst unavailability window sits inside the
+    # SLO bound (metadata flip + cache observation — never a drain)
+    fo = out["configs"]["failover_drill"]
+    for drill in ("managed", "forced", "failback"):
+        rec = fo[drill]
+        for key in ("handover_ms", "unavailability_ms",
+                    "lag_at_promote_events", "conflicts_resolved"):
+            assert key in rec, f"failover_drill.{drill} lacks {key}"
+        assert rec["unavailability_ms"] >= 0
+    assert fo["managed"]["lag_at_promote_events"] == 0, fo["managed"]
+    assert fo["failback"]["conflicts_resolved"] >= 1, fo["failback"]
+    assert fo["replication_lag_events_final"] == 0, fo
+    assert fo["slo"]["met"] is True, fo["slo"]
+    assert fo["slo"]["unavailability_ms_worst"] < \
+        fo["slo"]["unavailability_ms_bound"], fo["slo"]
     # the telemetry contract (ISSUE 10): headline latency lines are
     # Registry.timer_stats-backed histogram p50/p99 (echo — the
     # serving-shaped config — and rebuild_warm both carry them), and

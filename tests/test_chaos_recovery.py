@@ -1006,9 +1006,11 @@ class _GeoAdapter:
     def __init__(self, svc):
         self.svc = svc
 
-    def get_replication_messages(self, shard_id, last_retrieved_id):
+    def get_replication_messages(self, shard_id, last_retrieved_id,
+                                 max_tasks=None):
         return self.svc.get_replication_messages(
-            shard_id, last_retrieved_id, cluster="standby"
+            shard_id, last_retrieved_id, cluster="standby",
+            max_tasks=max_tasks,
         )
 
     def get_workflow_history_raw(self, *a):

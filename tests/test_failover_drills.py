@@ -1,0 +1,762 @@
+"""Graceful domain failover drills: managed handover, region-loss
+storms, and failback under the chaos differential discipline.
+
+The scenario zoo for ``runtime/replication/failover.py`` over the
+two-cluster xdc topology (the ROADMAP's "creative leap"):
+
+* **managed handover** — drain, bump ``failover_version`` through the
+  graceful path, flip ``active_cluster_name``, and prove zero lost
+  progress: a workflow started before the handover completes on the
+  new active side and both clusters converge byte-identical;
+* **forced failover on region loss** — partition the link mid-traffic
+  with divergent events outstanding, promote the standby, extend the
+  same workflow on BOTH sides of the partition, heal, and let the NDC
+  conflict-resolution path resolve the version-branch storm
+  (``replication_conflicts_resolved`` >= 1, signals from the orphaned
+  branch reapplied on the winner);
+* **failback** — return ownership to the recovered region and converge
+  byte-identical to the fault-free baseline of the SAME choreography
+  (the chaos differential discipline: the write-fault storm and the
+  throttled link may cost retries, never bytes).
+
+Also here: property tests for the failover-version arithmetic
+(``ClusterMetadata`` round-trips for any cluster pair) and for the
+standby allocator's handover re-arm (exactly once per observed
+failover), plus the FAILOVER_METRICS catalog coverage scan.
+
+Determinism discipline matches tests/test_chaos_recovery.py: shared
+frozen clock, pinned matching poll nonce, seeded fault schedules,
+explicit ordered replication drains. CHAOS_SEED sweeps via
+``CHAOS_FAILOVER=1 scripts/run_chaos.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from cadence_tpu.client import HistoryClient, MatchingClient
+from cadence_tpu.cluster import ClusterInformation, ClusterMetadata
+from cadence_tpu.frontend import DomainHandler, WorkflowHandler
+from cadence_tpu.matching import MatchingEngine
+from cadence_tpu.runtime.api import SignalRequest, StartWorkflowRequest
+from cadence_tpu.runtime.domains import DomainCache, register_domain
+from cadence_tpu.runtime.membership import single_host_monitor
+from cadence_tpu.runtime.persistence.decorators import wrap_bundle
+from cadence_tpu.runtime.persistence.errors import (
+    ConditionFailedError,
+    PersistenceError,
+)
+from cadence_tpu.runtime.persistence.memory import create_memory_bundle
+from cadence_tpu.runtime.replication import (
+    AdaptiveTransport,
+    ClusterHandle,
+    DomainFailoverCoordinator,
+    FailoverDrillError,
+    HistoryRereplicator,
+    ReplicationTaskFetcher,
+    ReplicationTaskProcessor,
+)
+from cadence_tpu.runtime.service import HistoryService
+from cadence_tpu.testing.faults import (
+    FaultRule,
+    FaultSchedule,
+    LinkPartitionedError,
+    LinkProfile,
+    chaos_link,
+)
+from cadence_tpu.utils.clock import FakeTimeSource
+from cadence_tpu.utils.metrics import Scope
+from cadence_tpu.worker import Worker
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "1234"))
+DOMAIN = "failover-dom"
+DOMAIN_ID = "failover-dom-0000"
+TL = "fo-tl"
+LIVE_TL = "fo-live-tl"   # pollerless until the completion phase
+
+# exceptions a chaos-arm drain may legitimately see and retry through:
+# the partition window and the injected write faults both hold the
+# cursor (at-least-once), they never lose bytes
+_RETRYABLE = (LinkPartitionedError, PersistenceError,
+              ConditionFailedError, TimeoutError)
+
+
+def _write_fault_schedule(seed):
+    """The suite's canonical >=10% write-fault storm (same shape as
+    tests/test_chaos_recovery.py): optimistic-concurrency failures on
+    the main execution write, hard errors on task completion, torn
+    shard-lease writes."""
+    return FaultSchedule(seed=seed, rules=[
+        FaultRule(site="persistence.execution",
+                  method="update_workflow_execution",
+                  probability=0.15, error="ConditionFailedError"),
+        FaultRule(site="persistence.execution",
+                  method="complete_transfer_task",
+                  probability=0.2, error="PersistenceError"),
+        FaultRule(site="persistence.shard", method="update_shard",
+                  probability=0.2, action="torn_write",
+                  error="TimeoutError"),
+    ])
+
+
+def _cluster_meta(current: str) -> ClusterMetadata:
+    return ClusterMetadata(
+        failover_version_increment=10,
+        master_cluster_name="active",
+        current_cluster_name=current,
+        cluster_info={
+            "active": ClusterInformation(initial_failover_version=1),
+            "standby": ClusterInformation(initial_failover_version=2),
+        },
+    )
+
+
+class _Adapter:
+    """RemoteClusterClient over an in-process peer's HistoryService;
+    ``consumer`` identifies the pulling cluster to the emit-side acks."""
+
+    def __init__(self, svc, consumer: str):
+        self.svc = svc
+        self.consumer = consumer
+
+    def get_replication_messages(self, shard_id, last_retrieved_id,
+                                 max_tasks=None):
+        return self.svc.get_replication_messages(
+            shard_id, last_retrieved_id, cluster=self.consumer,
+            max_tasks=max_tasks,
+        )
+
+    def get_workflow_history_raw(self, *a):
+        return self.svc.get_workflow_history_raw(*a)
+
+    def get_replication_backlog(self, shard_id, last_retrieved_id):
+        return self.svc.get_replication_backlog(shard_id, last_retrieved_id)
+
+    def get_replication_checkpoint(self, *a):
+        return self.svc.get_replication_checkpoint(*a)
+
+
+class FailoverDrillBox:
+    """Two full in-process clusters ("active", "standby") with
+    BIDIRECTIONAL pull replication over partitionable SimulatedLinks,
+    a shared frozen clock, and a DomainFailoverCoordinator wired over
+    both — the drill stage.
+
+    Replication is drained explicitly (by the coordinator's drill
+    steps or ``converge()``), so the choreography controls exactly
+    which events cross which link when — the determinism the byte
+    differential needs."""
+
+    def __init__(self, faults=None, link_profile=None):
+        self.clock = FakeTimeSource()
+        self.scopes = {"active": Scope(), "standby": Scope()}
+        self.clusters = {}
+        for name in ("active", "standby"):
+            self.clusters[name] = self._cluster(
+                name, faults if name == "active" else None
+            )
+        self.links = {}
+        self.processors = {}
+        transports = {}
+        for consumer, source in (("standby", "active"),
+                                 ("active", "standby")):
+            base = _Adapter(self.clusters[source]["svc"], consumer)
+            client = base
+            self.links[consumer] = None
+            if link_profile is not None:
+                wrapped = chaos_link(base, link_profile, seed=CHAOS_SEED)
+                self.links[consumer] = wrapped.link
+                client = wrapped
+            engine = self.clusters[consumer]["svc"].controller\
+                .get_engine_for_shard(0)
+            transport = None
+            if consumer == "standby":
+                # lag view at promote time rides the estimator; the
+                # heal itself stays on the event path (min_gap floor
+                # higher than any drill backlog)
+                transport = AdaptiveTransport(
+                    client, source, min_gap_events=1 << 30,
+                    metrics=self.scopes[consumer],
+                )
+            transports[consumer] = transport
+            rerepl = HistoryRereplicator(
+                client, engine.ndc_replicator, transport=transport,
+                metrics=self.scopes[consumer],
+            )
+            self.processors[consumer] = ReplicationTaskProcessor(
+                engine.shard, engine.ndc_replicator,
+                ReplicationTaskFetcher(source, client),
+                rereplicator=rerepl, metrics=self.scopes[consumer],
+                transport=transport,
+            )
+        self.failover_metrics = Scope()
+        self.coordinator = DomainFailoverCoordinator(
+            _cluster_meta("active"),
+            [
+                ClusterHandle(
+                    name=name,
+                    metadata=self.clusters[name]["persistence"].metadata,
+                    domains=self.clusters[name]["domains"],
+                    history=self.clusters[name]["svc"],
+                    processors=[self.processors[name]],
+                    transport=transports[name],
+                    registry=self.scopes[name].registry,
+                )
+                for name in ("active", "standby")
+            ],
+            metrics=self.failover_metrics,
+        )
+
+    def _cluster(self, name, faults):
+        scope = self.scopes[name]
+        persistence = create_memory_bundle()
+        if faults is not None:
+            persistence = wrap_bundle(
+                persistence, metrics=scope, faults=faults
+            )
+        register_domain(
+            persistence.metadata, DOMAIN, is_global=True,
+            clusters=["active", "standby"], active_cluster="active",
+            domain_id=DOMAIN_ID, failover_version=1,
+        )
+        domains = DomainCache(persistence.metadata)
+        svc = HistoryService(
+            1, persistence, domains, single_host_monitor(f"fo-{name}"),
+            time_source=self.clock, metrics=scope, faults=faults,
+            cluster_metadata=_cluster_meta(name),
+            # parked standby holds re-fire at test-scale cadence — the
+            # post-handover dispatch must not wait out the production
+            # park interval under suite load (the PR 1 chaos knob)
+            queue_exhausted_retry_delay_s=0.5,
+        )
+        hc = HistoryClient(svc.controller)
+        matching = MatchingEngine(
+            persistence.task, hc,
+            poll_request_id_fn=(
+                lambda info: f"rid-{info.workflow_id}-{info.schedule_id}"
+            ),
+        )
+        svc.wire(MatchingClient(matching), hc)
+        svc.start()
+        # small emit pages: several fetch cycles per drill, so paging,
+        # cursor holds, and partition windows all actually engage
+        svc.controller.get_engine_for_shard(0)\
+            .replicator_queue.batch_size = 4
+        frontend = WorkflowHandler(
+            DomainHandler(persistence.metadata, _cluster_meta(name)),
+            domains, hc, MatchingClient(matching),
+        )
+        return {
+            "svc": svc, "hc": hc, "matching": matching,
+            "persistence": persistence, "domains": domains,
+            "frontend": frontend,
+        }
+
+    # -- choreography controls ----------------------------------------
+
+    def partition(self, on: bool) -> None:
+        """Region loss: both directions of the WAN at once."""
+        for link in self.links.values():
+            if link is not None:
+                link.force_partition(on)
+
+    def converge(self, swallow=_RETRYABLE) -> int:
+        return self.coordinator.await_convergence(DOMAIN, swallow=swallow)
+
+    def frontend(self, cluster: str):
+        return self.clusters[cluster]["frontend"]
+
+    def history_json(self, cluster: str, wid: str, rid: str) -> str:
+        engine = self.clusters[cluster]["svc"].controller.get_engine(wid)
+        events, _ = engine.get_workflow_execution_history(DOMAIN, wid, rid)
+        return json.dumps(
+            [e.to_dict() for e in events], sort_keys=True, default=repr
+        )
+
+    def stop(self):
+        for c in self.clusters.values():
+            c["svc"].stop()
+            c["matching"].shutdown()
+
+
+def _doubler(ctx, input):
+    a = yield ctx.schedule_activity("double", input)
+    b = yield ctx.schedule_activity("double", a)
+    return b
+
+
+def _run_worker(box, cluster, task_list, wids, runs, timeout_s=60.0):
+    """Drive the named workflows to completion with a worker on one
+    cluster's frontend; sequential completion waits keep it
+    deterministic."""
+    fe = box.frontend(cluster)
+    w = Worker(fe, DOMAIN, task_list, identity="fo-worker", sticky=False)
+    w.register_workflow("fo-wf", _doubler)
+    w.register_activity("double", lambda inp: inp * 2)
+    w.start()
+    try:
+        deadline = time.monotonic() + timeout_s
+        for wid in wids:
+            while time.monotonic() < deadline:
+                d = fe.describe_workflow_execution(DOMAIN, wid, runs[wid])
+                if not d.is_running:
+                    break
+                time.sleep(0.02)
+            else:
+                # a wedged drill must explain itself: where did the
+                # dispatch stall — queue cursors or matching backlog?
+                svc = box.clusters[cluster]["svc"]
+                matching = box.clusters[cluster]["matching"]
+                try:
+                    queues = svc.describe_queue_states(0)
+                    backlog = matching.describe_task_list(
+                        DOMAIN_ID, task_list, 0
+                    )
+                except Exception as e:
+                    queues, backlog = f"<{e}>", "?"
+                raise AssertionError(
+                    f"workflow {wid} did not complete on {cluster}; "
+                    f"queues={queues} matching[{task_list}]={backlog}"
+                )
+    finally:
+        w.stop()
+
+
+def _start(box, cluster, wid, task_list):
+    return box.frontend(cluster).start_workflow_execution(
+        StartWorkflowRequest(
+            domain=DOMAIN, workflow_id=wid, workflow_type="fo-wf",
+            task_list=task_list, input=b"x", request_id=f"req-{wid}",
+            execution_start_to_close_timeout_seconds=600,
+        )
+    )
+
+
+def _signal(box, cluster, wid, name):
+    box.frontend(cluster).signal_workflow_execution(SignalRequest(
+        domain=DOMAIN, workflow_id=wid, signal_name=name,
+        input=b"x" * 48, identity=f"fo-{cluster}",
+    ))
+
+
+# ---------------------------------------------------------------------------
+# the region-loss choreography (shared by the chaos arm and its
+# fault-free differential baseline)
+# ---------------------------------------------------------------------------
+
+_DONE_WIDS = ["fo-done-0", "fo-done-1"]
+_LIVE_WID = "fo-live"
+_DRILL_CLEAN: dict = {}   # wid -> history json, fault-free baseline
+
+
+def _run_region_loss_drill(faults=None, link_profile=None):
+    """The full forced-failover + failback choreography. Returns
+    (histories, reports, box_stats) where histories maps wid -> the
+    ACTIVE cluster's canonical history JSON (asserted byte-identical
+    to the standby's within the run)."""
+    box = FailoverDrillBox(faults=faults, link_profile=link_profile)
+    reports = {}
+    try:
+        # 1. steady-state traffic on the active region
+        runs = {w: _start(box, "active", w, TL) for w in _DONE_WIDS}
+        runs[_LIVE_WID] = _start(box, "active", _LIVE_WID, LIVE_TL)
+        _run_worker(box, "active", TL, _DONE_WIDS, runs)
+        for k in range(4):
+            _signal(box, "active", _LIVE_WID, f"pre-{k}")
+        # 2. the standby is state-current before disaster strikes
+        box.converge()
+        # 3. divergent span: events the standby will NEVER see before
+        # the promotion (they are mid-flight when the region is lost)
+        for k in range(3):
+            _signal(box, "active", _LIVE_WID, f"orphan-{k}")
+        # 4. region loss: the WAN partitions both ways, mid-traffic
+        box.partition(True)
+        if box.links["standby"] is not None:
+            with pytest.raises(LinkPartitionedError):
+                box.processors["standby"].process_once()
+        # 5. promote the standby with divergence outstanding
+        reports["forced"] = box.coordinator.forced_failover(
+            DOMAIN, "standby", lost_clusters=["active"]
+        )
+        # 6. the new active region mints its own branch of the same
+        # workflow — the version-branch storm in the making
+        for k in range(3):
+            _signal(box, "standby", _LIVE_WID, f"promoted-{k}")
+        # 7. the lost region recovers; links heal
+        box.partition(False)
+        # 8. failback: converge (the conflict storm resolves here —
+        # the v2 branch wins on the recovered region, the orphaned v1
+        # signals reapply on the winner), then hand ownership home
+        reports["failback"] = box.coordinator.failback(
+            DOMAIN, "active", swallow=_RETRYABLE
+        )
+        # 9. finish the live workflow on the recovered home region
+        _run_worker(box, "active", LIVE_TL, [_LIVE_WID], runs)
+        box.converge()
+
+        histories = {}
+        for wid, rid in runs.items():
+            a = box.history_json("active", wid, rid)
+            b = box.history_json("standby", wid, rid)
+            assert a == b, (
+                f"clusters diverged for {wid} after failback"
+            )
+            histories[wid] = a
+        stats = {
+            "conflicts_active": box.scopes["active"].registry
+            .counter_value("replication_conflicts_resolved"),
+            "conflicts_standby": box.scopes["standby"].registry
+            .counter_value("replication_conflicts_resolved"),
+            "failover_registry": box.failover_metrics.registry,
+        }
+        return histories, reports, stats
+    finally:
+        box.stop()
+
+
+def _drill_clean_baseline():
+    """Fault-free, unthrottled run of the SAME choreography (the
+    partition toggles happen at the same points — the region loss is
+    the scenario, not the chaos)."""
+    if not _DRILL_CLEAN:
+        histories, reports, stats = _run_region_loss_drill(
+            link_profile=LinkProfile()   # partitionable, unthrottled
+        )
+        # the scenario itself must force conflict resolution even
+        # without faults, or the differential proves nothing
+        assert reports["failback"].conflicts_resolved >= 1
+        _DRILL_CLEAN.update(histories)
+    return dict(_DRILL_CLEAN)
+
+
+# ---------------------------------------------------------------------------
+# drills
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestFailoverManagedHandover:
+    def test_managed_handover_zero_lost_progress(self):
+        """The graceful path: drain → flip → observe. The workflow
+        started (and signaled) before the handover completes on the
+        NEW active side, both clusters converge byte-identical, and
+        the report shows a drained link at promote time."""
+        box = FailoverDrillBox()
+        try:
+            runs = {w: _start(box, "active", w, TL) for w in _DONE_WIDS}
+            runs[_LIVE_WID] = _start(box, "active", _LIVE_WID, LIVE_TL)
+            _run_worker(box, "active", TL, _DONE_WIDS, runs)
+            for k in range(3):
+                _signal(box, "active", _LIVE_WID, f"pre-{k}")
+
+            report = box.coordinator.managed_handover(DOMAIN, "standby")
+            assert report.kind == "managed"
+            assert report.from_cluster == "active"
+            assert report.to_cluster == "standby"
+            # graceful = the link was drained before the flip
+            assert report.replication_lag_at_promote == 0
+            assert report.handover_ms >= 0
+            assert report.unavailability_ms >= 0
+            # version arithmetic: owned by the standby, monotonic
+            meta = _cluster_meta("active")
+            assert meta.cluster_name_for_failover_version(
+                report.failover_version) == "standby"
+            assert report.failover_version > 1
+            # both clusters agree on ownership
+            for name in ("active", "standby"):
+                rec = box.clusters[name]["domains"].get_by_name(DOMAIN)
+                assert rec.replication_config.active_cluster_name == \
+                    "standby"
+
+            # zero lost progress: the live workflow completes on the
+            # NEW active side (its held decision task dispatched via
+            # the standby handover path)
+            _run_worker(box, "standby", LIVE_TL, [_LIVE_WID], runs)
+            box.converge(swallow=())
+            for wid, rid in runs.items():
+                assert box.history_json("active", wid, rid) == \
+                    box.history_json("standby", wid, rid), (
+                        f"clusters diverged for {wid} after handover"
+                    )
+            # the coordinator's metrics landed in the histogram plane
+            reg = box.failover_metrics.registry
+            assert reg.counter_value("domain_failovers") == 1
+            count, total, _ = reg.timer_stats("failover_handover_ms")
+            assert count == 1 and total >= 0
+        finally:
+            box.stop()
+
+    def test_handover_to_current_active_refused(self):
+        box = FailoverDrillBox()
+        try:
+            with pytest.raises(FailoverDrillError):
+                box.coordinator.managed_handover(DOMAIN, "active")
+        finally:
+            box.stop()
+
+
+@pytest.mark.chaos
+class TestFailoverRegionLossStorm:
+    def test_forced_failover_and_failback_byte_identical(self):
+        """THE acceptance drill: region loss mid-traffic with divergent
+        events outstanding, forced promotion, a conflict-resolution
+        storm on the heal, failback — under the >=10% write-fault
+        storm on a throttled link — converges byte-identical to the
+        fault-free baseline of the same choreography, with
+        conflicts_resolved >= 1 and a bounded unavailability window."""
+        clean = _drill_clean_baseline()
+
+        sched = _write_fault_schedule(CHAOS_SEED)
+        histories, reports, stats = _run_region_loss_drill(
+            faults=sched,
+            link_profile=LinkProfile(
+                bytes_per_s=96 * 1024.0, latency_s=0.001,
+                jitter_s=0.001, max_sleep_s=0.5,
+            ),
+        )
+        # the storm actually happened: faults landed across the rules,
+        # including the main execution write (the drill makes fewer
+        # update calls than the doubler-trio differential, so the
+        # per-method RATE floor of that suite would flake on unlucky
+        # seeds — presence on every rule plus the total is the proof)
+        assert sched.injected_total() >= 5, sched.snapshot()
+        update = next(
+            s for s in sched.snapshot()
+            if s["method"] == "update_workflow_execution"
+        )
+        assert update["injected"] >= 1, sched.snapshot()
+
+        # chaos differential: byte-identical to the fault-free run
+        for wid, h in histories.items():
+            assert h == clean[wid], (
+                f"history for {wid} diverged from the fault-free "
+                "baseline"
+            )
+
+        # the version-branch storm was real and resolved. The count is
+        # asserted at TOPOLOGY level: a fault-interrupted resolution on
+        # one cluster can complete across two attempts (the retry
+        # finishes the already-flipped branch through the appendable
+        # path) without re-entering the counted rebuild — the bytes
+        # converge either way, and the stale-side archive on the peer
+        # always counts
+        assert reports["failback"].conflicts_resolved >= 1
+        assert stats["conflicts_active"] + stats["conflicts_standby"] >= 1
+        # forced promotion reported the drill shape honestly
+        assert reports["forced"].kind == "forced"
+        assert reports["forced"].unreachable == ["active"]
+        assert reports["forced"].unavailability_ms >= 0
+        # bounded unavailability: the flip is metadata + cache pokes,
+        # never minutes of drain
+        assert reports["forced"].unavailability_ms < 10_000
+        assert reports["failback"].to_cluster == "active"
+        # every drill landed in the FAILOVER_METRICS plane
+        reg = stats["failover_registry"]
+        assert reg.counter_value("domain_failovers") == 2
+        count, _, _ = reg.timer_stats("failover_unavailability_ms")
+        assert count == 2
+
+    def test_orphaned_signals_reapplied_on_winner(self):
+        """The NDC events-reapplier half of the storm: the signals
+        minted on the lost region's branch must survive as REAPPLIED
+        events on the winning branch — lost-region writes are healed,
+        not dropped."""
+        clean = _drill_clean_baseline()
+        live = clean[_LIVE_WID]
+        for k in range(3):
+            assert f"orphan-{k}" in live, (
+                "an orphaned-branch signal vanished instead of being "
+                "reapplied on the winning branch"
+            )
+            assert f"promoted-{k}" in live
+
+
+# ---------------------------------------------------------------------------
+# failover-version arithmetic (property tests)
+# ---------------------------------------------------------------------------
+
+
+class TestFailoverVersionArithmetic:
+    def test_round_trip_for_any_cluster_pair(self):
+        """For randomized increments/initial versions and any cluster
+        pair: next_failover_version always lands on a version the
+        target cluster owns, at most one increment ahead, and
+        ownership alternation is strictly monotonic."""
+        rng = random.Random(CHAOS_SEED)
+        for _ in range(100):
+            increment = rng.randint(2, 1000)
+            k = rng.randint(2, min(increment, 6))
+            initials = rng.sample(range(increment), k)
+            names = [f"c{i}" for i in range(k)]
+            meta = ClusterMetadata(
+                failover_version_increment=increment,
+                master_cluster_name=names[0],
+                current_cluster_name=names[0],
+                cluster_info={
+                    n: ClusterInformation(initial_failover_version=v)
+                    for n, v in zip(names, initials)
+                },
+            )
+            for name in names:
+                v = rng.randint(-24, 10 * increment)
+                nv = meta.next_failover_version(name, v)
+                assert meta.cluster_name_for_failover_version(nv) == name
+                assert nv >= max(v, 0)
+                assert nv < max(v, 0) + increment
+            # ownership ping-pong between any pair is strictly
+            # monotonic and always resolvable back to the owner
+            a, b = rng.sample(names, 2)
+            v = meta.next_failover_version(a, 0)
+            for _ in range(6):
+                nv = meta.next_failover_version(b, v + 1)
+                assert nv > v
+                assert meta.cluster_name_for_failover_version(nv) == b
+                a, b, v = b, a, nv
+
+    def test_sentinel_and_corrupt_versions(self):
+        from cadence_tpu.core.ids import EMPTY_VERSION
+
+        meta = _cluster_meta("active")
+        # EMPTY_VERSION maps to cycle 0 of the target cluster
+        assert meta.next_failover_version("standby", EMPTY_VERSION) == 2
+        assert meta.cluster_name_for_failover_version(EMPTY_VERSION) == \
+            "active"
+        with pytest.raises(ValueError):
+            meta.cluster_name_for_failover_version(-3)
+        with pytest.raises(ValueError):
+            meta.next_failover_version("nope", 0)
+
+
+# ---------------------------------------------------------------------------
+# standby allocator: handover re-arms exactly once per failover
+# ---------------------------------------------------------------------------
+
+
+class _FakeDomains:
+    def __init__(self):
+        self.rec = None
+
+    def set(self, active, fv):
+        self.rec = SimpleNamespace(
+            is_global=True,
+            replication_config=SimpleNamespace(
+                active_cluster_name=active),
+            failover_version=fv,
+        )
+
+    def get_by_id(self, domain_id):
+        return self.rec
+
+
+class TestStandbyAllocatorRearm:
+    def _alloc(self, increment: int = 0):
+        from cadence_tpu.runtime.queues.standby import _StandbyAllocator
+
+        domains = _FakeDomains()
+        return domains, _StandbyAllocator(
+            domains, "remote", local_cluster="local",
+            failover_version_increment=increment,
+        )
+
+    def test_never_stood_by_plane_still_hands_over_after_failover(self):
+        """The drill-caught race: a plane whose FIRST read of a task
+        span lands after the flip never stood by for the domain, yet
+        the active plane may have skipped that span pre-flip — the
+        failover version (>= increment ⇒ at least one failover) arms
+        the handover claim anyway, exactly once per version."""
+        domains, alloc = self._alloc(increment=10)
+        domains.set("local", 11)  # first-ever observation: post-flip
+        assert alloc.classify("d1") == "handover"
+        assert alloc.claim_handover("d1") is True
+        assert alloc.claim_handover("d1") is False
+        assert alloc.classify("d1") == "other"
+
+    def test_steady_state_local_domain_never_hands_over(self):
+        """A domain registered locally active (cycle-0 version) has
+        never failed over: no spurious startup rewind."""
+        domains, alloc = self._alloc(increment=10)
+        domains.set("local", 2)   # registration version, cycle 0
+        assert alloc.classify("d1") == "other"
+        assert alloc.claim_handover("d1") is False
+
+    def test_handover_claimed_exactly_once_per_failover(self):
+        domains, alloc = self._alloc()
+        domains.set("remote", 2)
+        assert alloc.classify("d1") == "owned"
+        # failover: the domain becomes locally active
+        domains.set("local", 11)
+        assert alloc.classify("d1") == "handover"
+        assert alloc.claim_handover("d1") is True
+        # a second concurrent classifier loses the claim race
+        assert alloc.claim_handover("d1") is False
+        # and later tasks of the now-local domain are simply not ours
+        assert alloc.classify("d1") == "other"
+
+    def test_stale_record_cannot_rearm_after_claim(self):
+        """A worker holding a pre-failover record must not re-arm the
+        handover after another worker consumed it — that would rewind
+        the active cursor once per stale read, forever."""
+        domains, alloc = self._alloc()
+        domains.set("remote", 2)
+        assert alloc.classify("d1") == "owned"
+        domains.set("local", 11)
+        assert alloc.classify("d1") == "handover"
+        assert alloc.claim_handover("d1")
+        # stale record from before the failover
+        domains.set("remote", 2)
+        assert alloc.classify("d1") == "other"
+        # back to current: still consumed, still not a handover
+        domains.set("local", 11)
+        assert alloc.classify("d1") == "other"
+
+    def test_rearm_on_failed_callback_then_second_failover(self):
+        domains, alloc = self._alloc()
+        domains.set("remote", 2)
+        assert alloc.classify("d1") == "owned"
+        domains.set("local", 11)
+        assert alloc.classify("d1") == "handover"
+        assert alloc.claim_handover("d1")
+        # the rewind callback failed: the claim is given back and the
+        # next observer retries the handover
+        alloc.rearm_handover("d1")
+        assert alloc.classify("d1") == "handover"
+        assert alloc.claim_handover("d1")
+        # a SECOND full failover cycle re-arms exactly once more
+        domains.set("remote", 12)
+        assert alloc.classify("d1") == "owned"
+        domains.set("local", 21)
+        assert alloc.classify("d1") == "handover"
+        assert alloc.claim_handover("d1")
+        assert alloc.claim_handover("d1") is False
+
+
+# ---------------------------------------------------------------------------
+# metrics catalog coverage
+# ---------------------------------------------------------------------------
+
+
+def test_failover_metrics_catalog_covers_everything_emitted():
+    """Every metric failover.py emits is declared in FAILOVER_METRICS
+    and every declared name is really emitted — the same bidirectional
+    contract the replication tuple carries."""
+    import re
+
+    import cadence_tpu.runtime.replication.failover as fo
+    from cadence_tpu.utils.metrics_defs import FAILOVER_METRICS
+
+    with open(fo.__file__) as f:
+        src = f.read()
+    emitted = set(re.findall(
+        r"\.(?:inc|gauge|record)\(\s*\n?\s*[\"']([a-z_]+)[\"']", src
+    ))
+    assert emitted, "scan found no failover metric emissions"
+    assert emitted == set(FAILOVER_METRICS), (
+        f"catalog drift: emitted={sorted(emitted)} "
+        f"declared={sorted(FAILOVER_METRICS)}"
+    )

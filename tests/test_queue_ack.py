@@ -66,3 +66,48 @@ def test_rewind_noop_when_not_behind():
     ack = QueueAckManager(5, update_shard_ack=persisted.append)
     ack.rewind(7)
     assert ack.ack_level == 5 and not persisted
+
+
+def test_rewind_invalidates_in_flight_read_batch():
+    """The failover-drill race: a rewind landing between a batch READ
+    and its offers must reject the stale batch — otherwise the stale
+    offers re-bump the read cursor over the rewound span and the ack
+    sweep jumps the hole without the span ever re-processing (the
+    handed-over task is silently lost)."""
+    ack = QueueAckManager(0)
+    gen = ack.generation()
+    # the pump read tasks 1..6, offered 1..3, then a failover rewind
+    # landed (rewind to 0 is a no-op level-wise here, so use a real
+    # span: process past 3 first)
+    for k in (1, 2, 3):
+        assert ack.add(k, generation=gen)
+        ack.complete(k)
+    assert ack.update_ack_level() == 3
+    gen = ack.generation()
+    # a new batch 4..6 was read; the rewind lands mid-offer
+    assert ack.add(4, generation=gen)
+    ack.complete(4)
+    ack.rewind(1)
+    # stale offers from the pre-rewind batch are rejected...
+    assert not ack.add(5, generation=gen)
+    assert not ack.add(6, generation=gen)
+    ack.set_read_level(6, generation=gen)
+    # ...so the read cursor stays at the rewound level and the next
+    # read re-takes the whole span under the fresh generation
+    assert ack.read_level == 1
+    gen2 = ack.generation()
+    assert gen2 != gen
+    for k in (2, 3, 4, 5, 6):
+        assert ack.add(k, generation=gen2)
+        ack.complete(k)
+    assert ack.update_ack_level() == 6
+
+
+def test_unstamped_add_still_works():
+    """Callers without a generation stamp (timer pumps re-read from the
+    ack level every wake) keep the legacy contract."""
+    ack = QueueAckManager(0)
+    assert ack.add(1)
+    ack.rewind(0)  # no-op (not behind)
+    assert ack.add(2)
+    ack.complete(2)

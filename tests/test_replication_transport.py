@@ -397,6 +397,87 @@ class TestDurableProgress:
 
 
 # ---------------------------------------------------------------------------
+# dynamic per-link fetch paging
+# ---------------------------------------------------------------------------
+
+
+class TestDynamicFetchPaging:
+    def _transport(self):
+        from cadence_tpu.runtime.replication import AdaptiveTransport
+
+        return AdaptiveTransport(object(), "remote")
+
+    def test_unmeasured_link_keeps_static_default(self):
+        t = self._transport()
+        assert t.page_size() is None
+
+    def test_page_scales_with_measured_budget(self):
+        t = self._transport()
+        # 8 KB/s link, 2 KB per hydrated task -> 2 s target = 8 tasks
+        t.estimator.observe_transfer(8192, 1.0, n_events=8, n_tasks=4)
+        assert t.page_size() == 8
+        # a crawling link clamps at the floor instead of page=0
+        slow = self._transport()
+        slow.estimator.observe_transfer(256, 1.0, n_events=1, n_tasks=1)
+        assert slow.page_size() == slow.MIN_FETCH_PAGE
+        # a fat link clamps at the ceiling instead of unbounded pages
+        fast = self._transport()
+        fast.estimator.observe_transfer(
+            10_000_000, 1.0, n_events=100_000, n_tasks=100_000
+        )
+        assert fast.page_size() == fast.MAX_FETCH_PAGE
+
+    def test_fetcher_threads_page_hint_to_client(self):
+        seen = []
+
+        class _Recorder:
+            def get_replication_messages(self, shard_id,
+                                         last_retrieved_id,
+                                         max_tasks=None):
+                seen.append(max_tasks)
+                return ReplicationMessages(
+                    tasks=[], last_retrieved_id=last_retrieved_id
+                )
+
+        fetcher = ReplicationTaskFetcher("remote", _Recorder())
+        fetcher.fetch(0)
+        fetcher.fetch(0, max_tasks=7)
+        assert seen == [None, 7]
+
+    def test_emit_side_caps_page_and_reports_has_more(self):
+        from cadence_tpu.core.tasks import ReplicationTask
+        from cadence_tpu.runtime.replication import (
+            ReplicatorQueueProcessor,
+        )
+
+        rows = [ReplicationTask(task_id=i + 1) for i in range(10)]
+
+        class _Exec:
+            def get_replication_tasks(self, shard_id, last, n):
+                return [t for t in rows if t.task_id > last][:n]
+
+            def complete_replication_task(self, shard_id, task_id):
+                pass
+
+        shard = SimpleNamespace(
+            shard_id=0,
+            persistence=SimpleNamespace(execution=_Exec()),
+            now=lambda: 0,
+        )
+        q = ReplicatorQueueProcessor(shard, batch_size=100)
+        # consumer hint below the static page: 4 tasks served, more
+        # behind them (empty branch tokens hydrate to no messages, but
+        # the cursor math is the contract under test)
+        msgs = q.get_replication_messages("remote", 0, max_tasks=4)
+        assert msgs.last_retrieved_id == 4
+        assert msgs.has_more
+        # no hint: the static page serves the full backlog
+        msgs = q.get_replication_messages("remote", 0)
+        assert msgs.last_retrieved_id == 10
+        assert not msgs.has_more
+
+
+# ---------------------------------------------------------------------------
 # metric-name coverage (REPLICATION_METRICS is the contract)
 # ---------------------------------------------------------------------------
 

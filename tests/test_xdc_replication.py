@@ -83,9 +83,11 @@ class RemoteAdapter:
     def __init__(self, remote: Cluster):
         self.remote = remote
 
-    def get_replication_messages(self, shard_id: int, last_retrieved_id: int):
+    def get_replication_messages(self, shard_id: int, last_retrieved_id: int,
+                                 max_tasks=None):
         return self.remote.history.get_replication_messages(
-            shard_id, last_retrieved_id, cluster="standby"
+            shard_id, last_retrieved_id, cluster="standby",
+            max_tasks=max_tasks,
         )
 
     def get_workflow_history_raw(
@@ -93,6 +95,16 @@ class RemoteAdapter:
     ):
         return self.remote.history.get_workflow_history_raw(
             domain_id, workflow_id, run_id, start_event_id, end_event_id
+        )
+
+    def get_replication_backlog(self, shard_id, last_retrieved_id):
+        return self.remote.history.get_replication_backlog(
+            shard_id, last_retrieved_id
+        )
+
+    def get_replication_checkpoint(self, domain_id, workflow_id, run_id):
+        return self.remote.history.get_replication_checkpoint(
+            domain_id, workflow_id, run_id
         )
 
 
@@ -286,6 +298,74 @@ def test_standby_defers_tasks_until_failover(xdc):
         )
     )
     assert task is not None, "deferred decision task never dispatched"
+
+
+def test_snapshot_catchup_heals_continue_as_new_successor(xdc):
+    """A continue-as-new chain healed through the snapshot catch-up
+    path must materialize the chain SUCCESSOR on the standby: the new
+    run's first batch rides the predecessor's replication task, which
+    the summary-driven fast-forward bypasses — without the explicit
+    chain walk (rereplicator._heal_chain_successor) the successor
+    would never exist locally (it has no replication tasks of its own
+    until a second batch lands)."""
+    from cadence_tpu.runtime.replication import AdaptiveTransport
+    from cadence_tpu.utils.metrics import Scope
+
+    run_a = _start(xdc.active, "wf-chain")
+    _decide(
+        xdc.active, "tl",
+        [Decision(DecisionType.ContinueAsNewWorkflowExecution, {})],
+    )
+    active_engine = xdc.active.history.controller.get_engine("wf-chain")
+    cur = xdc.active.persistence.execution.get_current_execution(
+        active_engine.shard.shard_id, xdc.active.domain_id, "wf-chain"
+    )
+    run_b = cur.run_id
+    assert run_b != run_a
+
+    # a fresh consumer whose first page is NOT the whole backlog, so
+    # the adaptive catch-up (snapshot-pinned) owns the heal
+    active_engine.replicator_queue.batch_size = 1
+    scope = Scope()
+    standby_engine = xdc.standby.history.controller.get_engine("wf-chain")
+    transport = AdaptiveTransport(
+        xdc.adapter, "active", force_mode="snapshot", metrics=scope,
+    )
+    rerepl = HistoryRereplicator(
+        xdc.adapter, standby_engine.ndc_replicator, transport=transport,
+        metrics=scope,
+    )
+    proc = ReplicationTaskProcessor(
+        standby_engine.shard, standby_engine.ndc_replicator,
+        ReplicationTaskFetcher("active", xdc.adapter),
+        rereplicator=rerepl, metrics=scope, transport=transport,
+    )
+    proc.drain_tasks()
+
+    # the successor run exists on the standby, byte-identical
+    b_active, _ = active_engine.get_workflow_execution_history(
+        DOMAIN, "wf-chain", run_b
+    )
+    b_standby, _ = standby_engine.get_workflow_execution_history(
+        DOMAIN, "wf-chain", run_b
+    )
+    assert [(e.event_id, e.event_type, e.version) for e in b_active] == [
+        (e.event_id, e.event_type, e.version) for e in b_standby
+    ]
+    assert b_standby[0].event_type == EventType.WorkflowExecutionStarted
+    # and the predecessor converged byte-identical too (backfill debt)
+    a_active, _ = active_engine.get_workflow_execution_history(
+        DOMAIN, "wf-chain", run_a
+    )
+    assert [e.to_dict() for e in a_active] == [
+        e.to_dict() for e in _standby_history(xdc, "wf-chain", run_a)
+    ]
+    assert scope.registry.counter_value("replication_chain_heals") >= 1
+    # the current-run pointer on the standby resolves to the successor
+    s_cur = xdc.standby.persistence.execution.get_current_execution(
+        standby_engine.shard.shard_id, xdc.standby.domain_id, "wf-chain"
+    )
+    assert s_cur.run_id == run_b
 
 
 def test_replication_metrics_emitted(xdc):
