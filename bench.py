@@ -1206,6 +1206,154 @@ def _bench_rebuild_warm(n_hist: int, depth: int, iters: int,
     }
 
 
+def _bench_serve_continuous(workflows: int, qps: float, lanes: int = 64,
+                            prefix_frac: float = 0.4,
+                            min_events: int = 60, max_events: int = 400,
+                            delta_batches: int = 3,
+                            kind: str = "poisson"):
+    """Continuous-batching serving under open-loop load.
+
+    Builds ``workflows`` signal-dominated OPEN histories, seats a
+    prefix of each into the resident engine (cadence_tpu/serving/), and
+    drives the remaining batches as per-arrival Δ appends on an
+    open-loop schedule (``kind``: poisson | bursty) at sustained
+    ``qps`` through a token bucket. Every request's decision latency is
+    measured from its SCHEDULED arrival to the resident read — falling
+    behind shows up as queueing delay in the p99, exactly as it would
+    for real users (closed-loop benches hide this).
+
+    The O(Δ) proof is ``suffix_frac``: events the engine actually
+    composed across all appends ÷ events a cold per-arrival rebuild of
+    the same cohort would have replayed (each arrival re-replaying its
+    full prefix). ``events_per_append`` ≈ the mean Δ width — resident
+    appends never pay O(depth). p50/p99 come from the PR 9
+    exponential-bucket histograms (``Registry.timer_stats``), the same
+    plane production scrapes.
+    """
+    import random as _random
+
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.serving import (
+        ArrivalProcess,
+        OpenLoopHarness,
+        ResidentEngine,
+        ServeWorkload,
+    )
+    from cadence_tpu.testing import workloads as W
+    from cadence_tpu.utils.metrics import Scope
+    from cadence_tpu.utils.quotas import TokenBucket
+
+    caps = S.Capacities(
+        max_events=512, max_activities=2, max_timers=2,
+        max_children=2, max_request_cancels=2, max_signals_ext=4,
+        max_version_items=2)
+
+    def build(tag):
+        # same seed per call: the warm round sees IDENTICAL history
+        # shapes (and therefore identical jit keys) as the timed round
+        rng = _random.Random(46)
+        loads, cold_events, appended_events = [], 0, 0
+        for i in range(workflows):
+            batches = W.signal_history(
+                rng, min_events=min_events, max_events=max_events)
+            cut = max(1, int(len(batches) * prefix_frac))
+            deltas = [
+                batches[k : k + delta_batches]
+                for k in range(cut, len(batches), delta_batches)
+            ]
+            seen = sum(len(b) for b in batches[:cut])
+            for d in deltas:
+                dn = sum(len(b) for b in d)
+                seen += dn
+                appended_events += dn
+                cold_events += seen  # cold replays the full prefix
+            loads.append(ServeWorkload(
+                domain_id="bench", workflow_id=f"serve-{tag}-wf-{i}",
+                run_id=f"serve-{tag}-run-{i}", branch_token=b"",
+                prefix=batches[:cut], deltas=deltas,
+            ))
+        return loads, cold_events, appended_events
+
+    def drive(tag, scope):
+        loads, cold_events, appended_events = build(tag)
+        engine = ResidentEngine(lanes=lanes, caps=caps, metrics=scope)
+        harness = OpenLoopHarness(
+            engine, loads,
+            ArrivalProcess(qps=qps, kind=kind, seed=7),
+            metrics=scope,
+            # the admission token bucket: sized above the target rate
+            # so steady state admits, but a burst beyond 2x qps sheds
+            # load instead of queueing it into the p99
+            admission_bucket=TokenBucket(
+                rps=qps * 2.0, burst=max(8, int(qps))),
+        )
+        run = harness.run()
+        return loads, cold_events, appended_events, run, engine
+
+    # warm round first (untimed, own registry): jit compiles of the
+    # tick/seat shapes must not masquerade as open-loop queueing delay
+    # — same discipline as _time_chained / _bench_rebuild_warm
+    from cadence_tpu.utils.metrics import NOOP as _NOOP
+
+    drive("warm", _NOOP)[4].drain()
+    scope = Scope()
+    reg = scope.registry
+    loads, cold_events, appended_events, run, engine = drive(
+        "run", scope)
+    drained = engine.drain()
+
+    # cold comparison cohort: ONE batched rebuild of the final
+    # histories — context for what the resident plane displaced
+    from cadence_tpu.ops.dispatch import replay_stream
+
+    full = [
+        (w.workflow_id, w.run_id,
+         list(w.prefix) + [b for d in w.deltas for b in d])
+        for w in loads
+    ]
+    t0 = time.perf_counter()
+    replay_stream(full, caps=caps, lane_pack=True)
+    cold_cohort_ms = (time.perf_counter() - t0) * 1000
+    total_events = sum(
+        sum(len(b) for b in batches) for _, _, batches in full
+    )
+
+    stats = reg.timer_stats("serve_decision")
+    hits = reg.counter_value("serving_resident_hits")
+    misses = reg.counter_value("serving_cold_misses")
+    appends = reg.counter_value("serving_appends")
+    replayed = reg.counter_value("serving_events_replayed")
+    ticks = reg.counter_value("serving_ticks")
+    return {
+        "arrival": kind,
+        "workflows": workflows,
+        "lanes": lanes,
+        "requests": run["requests"],
+        "completed": run["completed"],
+        "shed": run["shed"],
+        "qps_target": round(run["qps_target"], 1),
+        "qps_sustained": round(run["qps_sustained"], 1),
+        "wall_s": round(run["wall_s"], 3),
+        # the SLO block: open-loop decision latency (scheduled arrival
+        # -> resident read done) off the histogram plane
+        "latency_p50_ms": round(stats.p50 * 1e3, 3),
+        "latency_p99_ms": round(stats.p99 * 1e3, 3),
+        "resident_hit_rate": round(hits / max(hits + misses, 1), 4),
+        # the O(Δ) block: composed ≈ appended, never ≈ cold
+        "appends": appends,
+        "ticks": ticks,
+        "appends_per_tick": round(appends / max(ticks, 1), 2),
+        "events_appended": appended_events,
+        "events_replayed": replayed,
+        "events_per_append": round(replayed / max(appends, 1), 2),
+        "cold_events_equiv": cold_events,
+        "suffix_frac": round(replayed / max(cold_events, 1), 4),
+        "total_events": total_events,
+        "cold_cohort_rebuild_ms": round(cold_cohort_ms, 3),
+        "drain_flush_failed": drained["flush_failed"],
+    }
+
+
 def _bench_telemetry_overhead(calls: int = 30000, rounds: int = 5):
     """Unsampled telemetry cost on the instrumented serving path.
 
@@ -1784,6 +1932,11 @@ def main() -> None:
         # (runtime/replication/failover.py; README "Domain failover")
         "failover_drill": dict(failover=dict(
             workflows=6, signals_each=24, bytes_per_s=131072.0)),
+        # continuous-batching serving under open-loop load: resident
+        # O(Δ) appends at sustained QPS, p50/p99 decision-latency SLOs
+        # (cadence_tpu/serving/; README "Continuous-batching serving")
+        "serve_continuous": dict(serve=dict(
+            workflows=48, qps=300.0, lanes=64)),
         # unsampled telemetry cost on the instrumented serving path:
         # the ≤3% guard tests/test_bench_smoke.py pins (utils/tracing)
         "telemetry_overhead": dict(telemetry=dict(
@@ -1823,9 +1976,20 @@ def main() -> None:
             # failover-drill JSON contract at seconds-scale load
             "failover_drill": dict(failover=dict(
                 workflows=2, signals_each=8, bytes_per_s=131072.0)),
-            # the ≤3% unsampled-tracing guard at smoke scale
+            # open-loop serving SLO contract at seconds-scale load
+            "serve_continuous": dict(serve=dict(
+                workflows=6, qps=120.0, lanes=8,
+                min_events=20, max_events=48)),
+            # the ≤3% unsampled-tracing guard at smoke scale. The
+            # min-over-paired-rounds estimator needs ONE clean pair;
+            # shorter rounds shrink the per-pair window a host stall
+            # can land in and more rounds multiply the chances of a
+            # clean one — 9x1500 costs ~the same 12k paired calls as
+            # the original 3x4000 with 3x the chances, after false
+            # >3% readings were observed on the loaded single-core CI
+            # host right after heavy suites
             "telemetry_overhead": dict(telemetry=dict(
-                calls=4000, rounds=3)),
+                calls=1500, rounds=9)),
         }
 
     copy_bw = measure_copy_bw_gbps() if not on_cpu else None
@@ -1870,6 +2034,13 @@ def main() -> None:
         elif "failover" in cfg:
             try:
                 results[config] = _bench_failover_drill(**cfg["failover"])
+            except Exception as e:
+                results[config] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        elif "serve" in cfg:
+            try:
+                results[config] = _bench_serve_continuous(**cfg["serve"])
             except Exception as e:
                 results[config] = {
                     "error": f"{type(e).__name__}: {str(e)[:200]}"

@@ -628,7 +628,9 @@ SCOPE_DIRS = ("cadence_tpu/runtime", "cadence_tpu/checkpoint",
               # listeners), and the rpc plane were unscanned lock
               # sites until the runtime witness demanded parity
               "cadence_tpu/frontend", "cadence_tpu/client",
-              "cadence_tpu/rpc")
+              "cadence_tpu/rpc",
+              # PR 14: the resident serving engine's lane-table lock
+              "cadence_tpu/serving")
 
 # single files outside the scanned packages that grew locks (PR 9's
 # telemetry plane: the flight-recorder ring and the registry series

@@ -42,6 +42,7 @@ SCAN_DIRS: Sequence[str] = (
     "cadence_tpu/ops",
     "cadence_tpu/matching",
     "cadence_tpu/checkpoint",
+    "cadence_tpu/serving",
 )
 
 EMIT_METHODS = frozenset({"inc", "gauge", "record"})

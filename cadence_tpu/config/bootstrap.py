@@ -43,6 +43,9 @@ class RunningServer:
     metrics: object = None
     # CheckpointManager when the checkpoint section is enabled
     checkpoints: object = None
+    # serving.ResidentEngine when the serving section is enabled
+    # (history hosts only); drained by HistoryService.stop()
+    serving: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
@@ -147,6 +150,17 @@ def start_services(
         store=getattr(persistence, "checkpoint", None)
     )
 
+    # serving section: the continuous-batching resident engine over
+    # the (chaos-wrapped) history manager + checkpoint plane — history
+    # hosts only, since only they see the persist feed
+    serving = None
+    if "history" in services:
+        serving = cfg.serving.build_engine(
+            checkpoints=checkpoints,
+            history=getattr(persistence, "history", None),
+            metrics=metrics,
+        )
+
     domains = DomainCache(persistence.metadata)
     cluster_metadata = cfg.build_cluster_metadata()
 
@@ -199,6 +213,7 @@ def start_services(
         faults=faults,
         metrics=metrics,
         checkpoints=checkpoints,
+        serving=serving,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
     # first configured service's port wins, bound on that service's
@@ -239,6 +254,7 @@ def start_services(
             faults=faults,
             metrics=metrics,
             checkpoints=checkpoints,
+            serving=serving,
         )
         # admin reshard verbs read the section off the service
         history.resharding_config = cfg.resharding

@@ -182,6 +182,47 @@ class CheckpointConfig:
 
 
 @dataclasses.dataclass
+class ServingConfig:
+    """Continuous-batching serving engine (cadence_tpu/serving/).
+
+    When enabled, the history service keeps hot workflows' state rows
+    resident in a fixed-``lanes`` device tensor: every persisted event
+    batch marks the lane behind (O(1) on the persist path), the next
+    serving tick composes just the Δ suffix through the assoc affine
+    algebra, and serving reads answer from the resident row with no
+    replay. ``idleTicks`` is the LRU eviction horizon (a lane untouched
+    that many ticks flushes back through the checkpoint plane and its
+    slot is recycled for the admission queue). OFF by default: a
+    disabled section builds nothing and the persist path pays nothing.
+    """
+
+    enabled: bool = False
+    lanes: int = 64
+    idle_ticks: int = 256
+
+    def validate(self) -> None:
+        if self.lanes < 1:
+            raise ConfigError("serving.lanes must be >= 1")
+        if self.idle_ticks < 1:
+            raise ConfigError("serving.idleTicks must be >= 1")
+
+    def build_engine(self, checkpoints=None, history=None, metrics=None):
+        """The ResidentEngine this section describes, or None when
+        disabled. ``checkpoints``/``history``: the host's
+        CheckpointManager (eviction flush + resume seeding; may be
+        None) and the persistence bundle's history manager (admission
+        reads + the persist-feed catch-up)."""
+        if not self.enabled:
+            return None
+        from cadence_tpu.serving import ResidentEngine
+
+        return ResidentEngine(
+            lanes=self.lanes, idle_ticks=self.idle_ticks,
+            checkpoints=checkpoints, history=history, metrics=metrics,
+        )
+
+
+@dataclasses.dataclass
 class ReshardingConfig:
     """Elastic resharding (runtime/resharding.py).
 
@@ -291,6 +332,9 @@ class ServerConfig:
     checkpoint: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig
     )
+    serving: ServingConfig = dataclasses.field(
+        default_factory=ServingConfig
+    )
     resharding: ReshardingConfig = dataclasses.field(
         default_factory=ReshardingConfig
     )
@@ -308,6 +352,7 @@ class ServerConfig:
         self.cluster.validate()
         self.chaos.validate()
         self.checkpoint.validate()
+        self.serving.validate()
         self.resharding.validate()
         self.replication.validate()
         self.telemetry.validate()
@@ -415,6 +460,14 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "everyEvents": "every_events",
             "keepLast": "keep_last",
         }, "checkpoint"))
+
+    srv = raw.pop("serving", None)
+    if srv:
+        cfg.serving = ServingConfig(**_take(srv, {
+            "enabled": "enabled",
+            "lanes": "lanes",
+            "idleTicks": "idle_ticks",
+        }, "serving"))
 
     rsh = raw.pop("resharding", None)
     if rsh:

@@ -47,7 +47,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 from cadence_tpu.utils.metrics import NOOP, Scope
 
 from . import schema as S
-from .pack import round_scan_len
+from .grid import round_scan_len, staging_depth
 
 
 def _jit_cache_total() -> int:
@@ -754,30 +754,39 @@ def replay_stream(
         raise ValueError("resume list must align with histories")
     any_resume = any(r is not None for r in resume)
     if bucket:
-        d = DeviceDispatcher(
-            caps=caps, depth=depth, kernel=kernel, lane_pack=True,
-            lane_len=lane_len, scan_mode=scan_mode, metrics=metrics,
-        )
-        n = 0
+        # plan the chunking FIRST so the staging queue is sized to the
+        # batches that exist (staging_depth) — a one-chunk stream (the
+        # common serving / small-rebuild shape) must not allocate
+        # double-buffer headroom it can never use
+        plan: List[Tuple] = []
         for idxs, hs in depth_buckets(histories):
             for j in range(0, len(hs), batch_size):
-                sub = idxs[j : j + batch_size]
-                d.submit(
-                    sub, hs[j : j + batch_size],
-                    resume=[resume[i] for i in sub] if any_resume else None,
-                )
-                n += 1
-        if n == 0:
+                plan.append((idxs[j : j + batch_size],
+                             hs[j : j + batch_size]))
+        if not plan:
             return out
+        d = DeviceDispatcher(
+            caps=caps, depth=staging_depth(len(plan), depth),
+            kernel=kernel, lane_pack=True,
+            lane_len=lane_len, scan_mode=scan_mode, metrics=metrics,
+        )
+        for sub, hs in plan:
+            d.submit(
+                sub, hs,
+                resume=[resume[i] for i in sub] if any_resume else None,
+            )
         d.finish()
         for idxs, packed, final in d.results():
             out.append((idxs, packed, final))
         return out
+    if not histories:
+        return out
+    n_batches = -(-len(histories) // batch_size)
     d = DeviceDispatcher(
-        caps=caps, depth=depth, kernel=kernel, lane_pack=lane_pack,
+        caps=caps, depth=staging_depth(n_batches, depth), kernel=kernel,
+        lane_pack=lane_pack,
         lane_len=lane_len, scan_mode=scan_mode, metrics=metrics,
     )
-    n = 0
     for i in range(0, len(histories), batch_size):
         d.submit(
             i, histories[i : i + batch_size],
@@ -785,9 +794,6 @@ def replay_stream(
                 resume[i : i + batch_size] if any_resume else None
             ),
         )
-        n += 1
-    if n == 0:
-        return out
     d.finish()
     for _, packed, final in d.results():
         out.append((packed, final))

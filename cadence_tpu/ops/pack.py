@@ -42,22 +42,9 @@ from . import schema as S
 SECONDS = 1_000_000_000  # ns per second
 _INT32_MAX = 2**31 - 1
 
-
-def round_scan_len(n: int, floor: int = 8) -> int:
-    """Round ``n`` up to the {2^k, 3·2^(k-1)} geometric grid.
-
-    Scan length and batch width are jit specialization keys: rounding
-    them to this grid bounds how many executables a storm of
-    arbitrary-sized batches can force (≤ 2 per octave) at < 50% padding
-    worst case (just past a power of two), ~20% expected.
-    """
-    if n <= floor:
-        return floor
-    k = (n - 1).bit_length()
-    p = 1 << k
-    if 3 * (p >> 2) >= n:
-        return 3 * (p >> 2)
-    return p
+# the shared compiled-shape policy (ops/grid.py) — re-exported because
+# every packer caller historically imported the grid from here
+from .grid import round_scan_len  # noqa: E402,F401
 
 
 class PackError(Exception):

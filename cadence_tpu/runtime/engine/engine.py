@@ -126,6 +126,16 @@ class HistoryEngine:
             ei.domain_id, ei.workflow_id, ei.run_id,
             ms.next_event_id, ms.is_workflow_execution_running(),
         )
+        # continuous-batching serving feed (config `serving:`): O(1) —
+        # marks a seated lane behind; the next serving tick composes
+        # just the Δ suffix. Unseated workflows are one dict miss
+        serving = getattr(self, "serving", None)
+        if serving is not None:
+            serving.on_persisted(
+                ei.domain_id, ei.workflow_id, ei.run_id,
+                ms.next_event_id,
+                running=ms.is_workflow_execution_running(),
+            )
 
     def _notify(self, result: TransactionResult) -> None:
         if result.transfer_tasks or result.new_run_transfer_tasks:
@@ -1161,6 +1171,7 @@ class HistoryEngine:
                 faults=getattr(self, "faults", None),
                 checkpoints=getattr(self, "checkpoints", None),
                 metrics=getattr(self, "metrics", None),
+                serving=getattr(self, "serving", None),
             )
         return self._ndc_replicator
 

@@ -65,6 +65,7 @@ class NDCHistoryReplicator:
         faults=None,
         checkpoints=None,
         metrics=None,
+        serving=None,
     ) -> None:
         self.shard = shard
         self.domains = domains
@@ -83,6 +84,7 @@ class NDCHistoryReplicator:
             chunk_size=rebuild_chunk_size,
             checkpoints=checkpoints,
             metrics=metrics,
+            serving=serving,
         )
         # whether this cluster is currently active for a domain (drives
         # signal reapplication; standby clusters never mint events)

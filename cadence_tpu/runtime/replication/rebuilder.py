@@ -67,7 +67,7 @@ class StateRebuilder:
     def __init__(self, history: HistoryManager,
                  domain_resolver=lambda name: name,
                  chunk_size=0, lane_len: int = 1024,
-                 checkpoints=None, metrics=None) -> None:
+                 checkpoints=None, metrics=None, serving=None) -> None:
         self.history = history
         self.domain_resolver = domain_resolver
         # device-dispatch chunk for rebuild_many: an int, or a callable
@@ -80,6 +80,11 @@ class StateRebuilder:
         self.lane_len = lane_len
         # checkpoint.CheckpointManager (or None: every rebuild is cold)
         self.checkpoints = checkpoints
+        # serving.ResidentEngine (config `serving:` section, or None):
+        # a rebuild whose target tip + branch + version histories match
+        # a resident lane rehydrates from the row — no history read, no
+        # replay (counted as serving_resident_hits)
+        self.serving = serving
         # checkpoint_hit/miss/invalidated + events_replayed_saved land
         # here (utils/metrics_defs.py CHECKPOINT_METRICS); the raw scope
         # also feeds the dispatcher's device-step telemetry
@@ -154,6 +159,52 @@ class StateRebuilder:
 
     # -- checkpoint consult --------------------------------------------
 
+    def _consult_serving(self, req: RebuildRequest):
+        """Rehydrate one rebuild from a resident serving lane, or None.
+
+        Sound only under exact-match guards: the caller pinned the
+        target tip (``next_event_id``) and the lane is at it, the lane
+        was seated from the SAME branch, and — when the caller supplied
+        them (the NDC path) — the lane's version-history items equal
+        the target's. Anything else (including a dirty lane the engine
+        fails to compose) falls through to the checkpoint/cold path.
+        Never raises."""
+        if self.serving is None or not req.next_event_id:
+            return None
+        try:
+            from cadence_tpu.ops import schema as S
+
+            got = self.serving.resident_row(
+                req.workflow_id, req.run_id, domain_id=req.domain_id
+            )
+            if got is None:
+                return None
+            if got.branch_token and got.branch_token != req.branch_token:
+                return None
+            row = got.state_row
+            tip = int(row["exec_info"][S.X_NEXT_EVENT_ID])
+            if tip != req.next_event_id:
+                return None
+            if req.version_history_items is not None:
+                n = int(row["vh_len"])
+                items = [
+                    (int(e), int(v)) for e, v in row["vh_items"][:n]
+                ]
+                want = [
+                    (int(e), int(v))
+                    for e, v in req.version_history_items
+                ]
+                if items != want:
+                    return None
+            ms = got.mutable_state()
+        except Exception:
+            return None
+        ms.execution_info.branch_token = req.branch_token
+        transfer, timer = refresh_tasks(ms)
+        (self._raw_metrics if self._raw_metrics is not None else NOOP
+         ).tagged(layer="serving").inc("serving_resident_hits")
+        return ms, transfer, timer
+
     def _consult_checkpoint(self, req: RebuildRequest, caps):
         """The resumable checkpoint for one request, or None; never
         raises. Misses/invalidations count here (they are final); a HIT
@@ -227,20 +278,24 @@ class StateRebuilder:
         except Exception:  # jax unavailable — host path
             return [self.rebuild(r) for r in reqs]
 
+        from cadence_tpu.ops import schema as S
+        from cadence_tpu.ops.grid import staging_depth
+
         out: List[Optional[Tuple[MutableState, list, list]]] = (
             [None] * len(reqs)
         )
-        d = DeviceDispatcher(
-            domain_resolver=self.domain_resolver, lane_pack=True,
-            lane_len=self.lane_len, metrics=self._raw_metrics,
-        )
+        caps = S.Capacities()
 
         # consult checkpoints, read only what must be replayed
         histories = []           # pending (wf, run, suffix batches)
         resumes = []             # aligned Optional[ResumeState]
         pend_req: List[int] = []  # pending index -> request index
         for gi, r in enumerate(reqs):
-            ckpt = self._consult_checkpoint(r, d.caps)
+            hit = self._consult_serving(r)
+            if hit is not None:
+                out[gi] = hit
+                continue
+            ckpt = self._consult_checkpoint(r, caps)
             if ckpt is None:
                 batches = self._read_batches(r)
                 resume = None
@@ -282,18 +337,26 @@ class StateRebuilder:
         # chunk (capacity overflow etc.) falls back per-workflow to the
         # host oracle
         chunk = self._resolve_chunk()
-        n_chunks = 0
+        plan = []
         for idxs, hs in depth_buckets(histories):
             for j in range(0, len(hs), chunk):
-                sub = idxs[j : j + chunk]
-                d.submit(
-                    tuple(pend_req[i] for i in sub),
-                    hs[j : j + chunk],
-                    resume=[resumes[i] for i in sub],
-                )
-                n_chunks += 1
-        if n_chunks == 0:
+                plan.append((idxs[j : j + chunk], hs[j : j + chunk]))
+        if not plan:
             return out
+        # the dispatcher is built only once the chunk plan exists, so
+        # its staging buffer is sized per batch (staging_depth) — the
+        # one-chunk serving/small-rebuild shape gets a one-slot queue
+        d = DeviceDispatcher(
+            caps=caps, depth=staging_depth(len(plan)),
+            domain_resolver=self.domain_resolver, lane_pack=True,
+            lane_len=self.lane_len, metrics=self._raw_metrics,
+        )
+        for sub, hs in plan:
+            d.submit(
+                tuple(pend_req[i] for i in sub),
+                hs,
+                resume=[resumes[i] for i in sub],
+            )
         d.finish()
         for item in d.results(strict=False):
             if isinstance(item, DispatchError):

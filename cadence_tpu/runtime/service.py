@@ -45,6 +45,7 @@ class HistoryService:
         faults=None,
         queue_exhausted_retry_delay_s: Optional[float] = None,
         checkpoints=None,
+        serving=None,
     ) -> None:
         from cadence_tpu.utils.metrics import Scope
 
@@ -74,6 +75,12 @@ class HistoryService:
         # every shard's state rebuilder resumes replays from durable
         # snapshots and writes fresh ones. None = cold rebuilds only.
         self.checkpoints = checkpoints
+        # serving.ResidentEngine (config `serving:` section): hot
+        # workflows' state rows stay device-resident; every persisted
+        # event batch marks the lane behind (O(1)), serving reads
+        # answer from the resident row with the Δ composed. None =
+        # every serving read is a cold rebuild
+        self.serving = serving
         # config.ReshardingConfig (`resharding:` section) — read by the
         # admin reshard verbs; None = defaults (enabled)
         self.resharding_config = None
@@ -117,6 +124,11 @@ class HistoryService:
         self.controller.acquire_shards()
 
     def stop(self) -> None:
+        if self.serving is not None:
+            # flush every resident lane back through the checkpoint
+            # plane before the shards go away (clean drain: the next
+            # boot's admissions resume suffix-only)
+            self.serving.drain()
         self.controller.stop()
 
     # -- per-shard assembly --------------------------------------------
@@ -132,6 +144,7 @@ class HistoryService:
         engine.rebuild_chunk_size = self.rebuild_chunk_size
         engine.faults = self.faults
         engine.checkpoints = self.checkpoints
+        engine.serving = self.serving
         engine.matching_client = self.matching_client
         has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(
@@ -278,6 +291,51 @@ class HistoryService:
         self._log.info(
             f"domain {domain_id} failed over {old_cluster}->{new_cluster}; "
             "rewound active queue cursors to standby levels"
+        )
+
+    # -- serving plane -------------------------------------------------
+
+    def serving_read(
+        self, domain_id: str, workflow_id: str, run_id: str = ""
+    ):
+        """Serving-plane decision/query read (config `serving:`): a hot
+        workflow answers straight from its resident lane (Δs composed
+        first); a miss seats the workflow — the next read is resident.
+        Returns a serving.ResidentRead; None when the serving caps
+        cannot pack the history (``serving_cold_read_failures`` — the
+        rebuild verbs stay the recovery path); raises RuntimeError when
+        the section is disabled (callers fall back to the rebuild
+        path)."""
+        import time as _time
+
+        if self.serving is None:
+            raise RuntimeError("serving: section not enabled")
+        t0 = _time.perf_counter()
+        engine = self.controller.get_engine(workflow_id)
+        shard = engine.shard
+        if not run_id:
+            run_id = shard.persistence.execution.get_current_execution(
+                shard.shard_id, domain_id, workflow_id
+            ).run_id
+        got = self.serving.resident_row(
+            workflow_id, run_id, domain_id=domain_id
+        )
+        if got is not None:
+            # same accounting as the engine's own read verbs, so
+            # resident-hit latency never vanishes from the histogram
+            # depending on which entry point answered
+            scope = self.metrics.tagged(layer="serving")
+            scope.inc("serving_resident_hits")
+            scope.record(
+                "serving_read_seconds", _time.perf_counter() - t0
+            )
+            return got
+        resp = shard.persistence.execution.get_workflow_execution(
+            shard.shard_id, domain_id, workflow_id, run_id
+        )
+        branch_token = resp.snapshot["execution_info"]["branch_token"]
+        return self.serving.read_through(
+            domain_id, workflow_id, run_id, branch_token
         )
 
     # -- introspection -------------------------------------------------

@@ -1,0 +1,25 @@
+"""Continuous-batching serving engine: device-resident hot state with
+O(Δ) replay-on-append.
+
+``ResidentEngine`` (engine.py) owns a fixed-shape resident state tensor
+of S lanes and applies per-append suffix compositions in one fused
+device step per tick — LLM-style continuous batching for workflow
+replay. ``harness.py`` is the open-loop SLO load harness (Poisson /
+bursty arrival processes at sustained QPS through token buckets).
+"""
+
+from .engine import (
+    LaneTicket,
+    ResidentEngine,
+    ResidentRead,
+)
+from .harness import ArrivalProcess, OpenLoopHarness, ServeWorkload
+
+__all__ = [
+    "ArrivalProcess",
+    "LaneTicket",
+    "OpenLoopHarness",
+    "ResidentEngine",
+    "ResidentRead",
+    "ServeWorkload",
+]

@@ -1,0 +1,254 @@
+"""Open-loop SLO load harness for the resident serving engine.
+
+Closed-loop benches (issue N requests, wait, repeat) hide queueing:
+when the server slows down, the load generator slows down with it and
+the reported latency stays flat. This harness is OPEN-LOOP — arrival
+times are drawn from an arrival process (Poisson or bursty) at a
+sustained target QPS BEFORE the run starts, and every request's
+latency is measured from its SCHEDULED arrival to completion, so
+falling behind shows up as queueing delay in the p99, exactly as it
+would for real users.
+
+Admission rides the existing token buckets (``utils/quotas``): a
+request the bucket rejects counts as shed load, not latency.
+
+Per-arrival shape (the serving hot path): ``append(Δ)`` → engine tick
+(all due arrivals in one fused step — continuous batching) →
+``read()``; the decision latency histogram lands in the PR 9
+exponential-bucket registry (``Registry.timer_stats``), which is where
+the reported p50/p99 come from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time as _time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from cadence_tpu.utils.metrics import NOOP, Scope
+from cadence_tpu.utils.quotas import TokenBucket
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalProcess:
+    """Deterministic (seeded) open-loop arrival schedule.
+
+    ``kind``: ``poisson`` (exponential inter-arrivals at ``qps``) or
+    ``bursty`` (Poisson base with ``burst_factor``× rate inside
+    periodic burst windows covering ``burst_frac`` of the run — the
+    thundering-herd shape an SLO has to survive)."""
+
+    qps: float
+    kind: str = "poisson"
+    seed: int = 0
+    burst_factor: float = 4.0
+    burst_frac: float = 0.2
+    burst_period_s: float = 1.0
+
+    def validate(self) -> None:
+        if self.qps <= 0:
+            raise ValueError("arrival process: qps must be > 0")
+        if self.kind not in ("poisson", "bursty"):
+            raise ValueError(
+                f"arrival process: unknown kind '{self.kind}'"
+            )
+        if self.kind == "bursty":
+            if not 0.0 < self.burst_frac < 1.0:
+                raise ValueError(
+                    "arrival process: burst_frac must be in (0, 1)"
+                )
+            if self.burst_factor <= 1.0:
+                raise ValueError(
+                    "arrival process: burst_factor must be > 1"
+                )
+
+    def schedule(self, n: int) -> List[float]:
+        """The first ``n`` arrival offsets (seconds from start)."""
+        self.validate()
+        rng = random.Random(self.seed)
+        out: List[float] = []
+        t = 0.0
+        while len(out) < n:
+            if self.kind == "poisson":
+                rate = self.qps
+            else:
+                # burst windows: [0, burst_frac) of every period runs
+                # at burst_factor × the off-window rate; the average
+                # over a period is the target qps
+                f, k = self.burst_frac, self.burst_factor
+                base = self.qps / (f * k + (1.0 - f))
+                in_burst = (t % self.burst_period_s) < (
+                    f * self.burst_period_s
+                )
+                rate = base * (k if in_burst else 1.0)
+            t += rng.expovariate(rate)
+            out.append(t)
+        return out
+
+
+@dataclasses.dataclass
+class ServeWorkload:
+    """One workflow's serve trajectory: the admit prefix plus the Δ
+    suffixes the open-loop arrivals will append, in order."""
+
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    branch_token: bytes
+    prefix: List            # batches replayed at admit
+    deltas: List[List]      # per-arrival Δ (each a list of batches)
+
+    @property
+    def total_events(self) -> int:
+        return sum(len(b) for b in self.prefix) + sum(
+            len(b) for d in self.deltas for b in d
+        )
+
+
+class OpenLoopHarness:
+    """Drive a ResidentEngine with an open-loop arrival schedule.
+
+    ``run()`` admits every workload (the warm phase — bulk, through
+    the dispatcher), then walks the arrival schedule: all arrivals due
+    by "now" append their Δs, ONE engine tick composes them (the
+    continuous batch), and each request's read completes it. Latency
+    is recorded scheduled-arrival → read-complete into
+    ``metrics.timer("serve_decision")``.
+    """
+
+    def __init__(
+        self,
+        engine,
+        workloads: Sequence[ServeWorkload],
+        process: ArrivalProcess,
+        metrics: Optional[Scope] = None,
+        admission_bucket: Optional[TokenBucket] = None,
+        clock: Callable[[], float] = _time.monotonic,
+        sleep: Callable[[float], None] = _time.sleep,
+        max_wait_s: float = 0.25,
+    ) -> None:
+        self.engine = engine
+        self.workloads = list(workloads)
+        self.process = process
+        self.metrics = (
+            metrics if metrics is not None else NOOP
+        ).tagged(layer="serving_harness")
+        self.bucket = admission_bucket
+        self._clock = clock
+        self._sleep = sleep
+        self._max_wait_s = max_wait_s
+
+    def admit_all(self) -> Dict:
+        """Warm phase: seat every workload in one bulk admission."""
+        tickets = self.engine.admit_many([
+            dict(domain_id=w.domain_id, workflow_id=w.workflow_id,
+                 run_id=w.run_id, branch_token=w.branch_token,
+                 batches=w.prefix)
+            for w in self.workloads
+        ])
+        return tickets
+
+    @staticmethod
+    def _through(w: ServeWorkload, k: int) -> List:
+        """The full event stream up to and including Δ ``k`` — the
+        re-seat batches after a shed/stale gap."""
+        return list(w.prefix) + [
+            b for d in w.deltas[: k + 1] for b in d
+        ]
+
+    def run(self) -> Dict:
+        """The open-loop drive; returns the run's SLO stats."""
+        tickets = self.admit_all()
+        # one arrival per available Δ, round-robin over workloads
+        order: List[Tuple[ServeWorkload, List, int]] = []
+        max_deltas = max(
+            (len(w.deltas) for w in self.workloads), default=0
+        )
+        for k in range(max_deltas):
+            for w in self.workloads:
+                if k < len(w.deltas):
+                    order.append((w, w.deltas[k], k))
+        schedule = self.process.schedule(len(order))
+        t_start = self._clock()
+        shed = completed = 0
+        latencies_recorded = 0
+        i = 0
+        while i < len(order):
+            now = self._clock() - t_start
+            if schedule[i] > now:
+                self._sleep(
+                    min(schedule[i] - now, self._max_wait_s)
+                )
+                continue
+            # continuous batch: every arrival due by now appends first,
+            # then ONE tick composes all of them
+            due: List[Tuple[int, ServeWorkload]] = []
+            while i < len(order) and schedule[i] <= now:
+                w, delta, k = order[i]
+                if self.bucket is not None and not self.bucket.allow():
+                    shed += 1
+                    self.metrics.inc("serve_shed")
+                    i += 1
+                    continue
+                key = (w.workflow_id, w.run_id)
+                t = tickets.get(key)
+                if t is None:
+                    # queued admission: retry the seat at THIS
+                    # arrival's position (earlier arrivals may have
+                    # been shed while unseated — seating the bare
+                    # prefix would leave a permanent gap)
+                    t = self.engine.admit(
+                        w.domain_id, w.workflow_id, w.run_id,
+                        branch_token=w.branch_token,
+                        batches=self._through(w, k),
+                    )
+                    tickets[key] = t
+                    ok = t is not None
+                elif not self.engine.append(t, delta):
+                    # stale ticket (recycled lane) or the gap a shed
+                    # arrival left behind: re-seat at this position —
+                    # the O(depth) re-admit is honest latency, never a
+                    # frozen lane or divergent resident state
+                    self.engine.evict(w.workflow_id, w.run_id)
+                    t = self.engine.admit(
+                        w.domain_id, w.workflow_id, w.run_id,
+                        branch_token=w.branch_token,
+                        batches=self._through(w, k),
+                    )
+                    tickets[key] = t
+                    ok = t is not None
+                else:
+                    ok = True
+                if not ok:
+                    shed += 1
+                    self.metrics.inc("serve_shed")
+                    i += 1
+                    continue
+                due.append((i, w))
+                i += 1
+            if not due:
+                continue
+            self.engine.tick()
+            for j, w in due:
+                got = self.engine.read(w.workflow_id, w.run_id)
+                t_read = self._clock() - t_start
+                assert got is not None, (
+                    f"resident read lost {w.workflow_id}"
+                )
+                # open-loop latency: scheduled arrival → read done
+                # (queueing delay from falling behind is IN the number)
+                self.metrics.record(
+                    "serve_decision", t_read - schedule[j]
+                )
+                latencies_recorded += 1
+                completed += 1
+        wall = self._clock() - t_start
+        return {
+            "requests": len(order),
+            "completed": completed,
+            "shed": shed,
+            "wall_s": wall,
+            "qps_sustained": completed / wall if wall > 0 else 0.0,
+            "qps_target": self.process.qps,
+        }

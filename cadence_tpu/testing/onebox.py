@@ -42,6 +42,7 @@ class Onebox:
         time_source=None,
         poll_request_id_fn=None,
         checkpoints=None,
+        serving=None,
         sanitize: bool = False,
     ) -> None:
         self.faults = faults
@@ -83,6 +84,19 @@ class Onebox:
                 if self.persistence.checkpoint is not None else None
             )
         self.checkpoints = checkpoints or None
+        # serving: True builds a ResidentEngine (continuous-batching
+        # resident serving megabatch) over the fault-wrapped history
+        # manager + this box's checkpoint plane; or pass a ready
+        # ResidentEngine; None/False = serving reads rebuild cold
+        if serving is True:
+            from cadence_tpu.serving import ResidentEngine
+
+            serving = ResidentEngine(
+                checkpoints=self.checkpoints,
+                history=self.persistence.history,
+                metrics=self.metrics,
+            )
+        self.serving = serving or None
         self.history = HistoryService(
             num_shards, self.persistence, self.domains, self.monitor,
             cluster_metadata=self.cluster_metadata,
@@ -91,6 +105,7 @@ class Onebox:
             faults=faults,
             time_source=time_source,
             checkpoints=self.checkpoints,
+            serving=self.serving,
         )
         self.history_client = HistoryClient(self.history.controller)
         # the clock and the poll nonce are the two entropy sources a

@@ -226,6 +226,77 @@ DEVICE_METRICS = (
     "jit_retraces",
 )
 
+# continuous-batching serving engine (cadence_tpu/serving/), emitted
+# under tags (layer=serving) by the ResidentEngine and
+# (layer=serving_harness) by the open-loop load harness:
+#
+#   serving_admits            counter — workflows seated into lanes
+#   serving_admit_cold        counter — seats that cold-replayed the prefix
+#   serving_admit_resume      counter — seats rehydrated from a checkpoint
+#   serving_admit_queued      counter — admits parked (all lanes busy)
+#   serving_admit_failures    counter — seats dropped (unpackable history)
+#   serving_appends           counter — Δ suffixes staged
+#   serving_append_events     counter — events across staged Δs
+#   serving_stale_appends     counter — generation-stamp rejections (a
+#                             stale ticket/in-flight step on a recycled
+#                             slot — the invariant, observable)
+#   serving_gapped_appends    counter — appends refused because events
+#                             between the staged tip and the batch
+#                             never arrived (bare lanes only; history-
+#                             backed lanes record the debt and the
+#                             catch-up heals it)
+#   serving_ticks             counter — fused device steps run
+#   serving_tick_seconds      histogram — per-tick wall time
+#   serving_append_width      counter per grid-rounded width tag —
+#                             lanes composed per tick (the batch shape)
+#   serving_events_replayed   counter — events composed (O(Δ) proof:
+#                             ≈ serving_append_events, never O(depth))
+#   serving_compose_failures  counter — lanes whose Δ was unreplayable
+#                             (lane freed; readmit-from-store recovers)
+#   serving_lane_occupancy    gauge — seated lanes ÷ S
+#   serving_evictions         counter — lanes flushed + freed
+#   serving_recycles          counter — freed slots refilled from the
+#                             admission queue
+#   serving_flush_failures    counter — eviction flushes that did not
+#                             land (readmit degrades to cold replay)
+#   serving_resident_hits     counter — reads answered from a lane
+#   serving_cold_misses       counter — reads that fell to cold replay
+#   serving_cold_read_failures counter — cold reads the serving caps
+#                             could not pack/replay (returned None;
+#                             the rebuild verbs stay the recovery path)
+#   serving_read_seconds      histogram — read wall time
+#   serve_decision            histogram — open-loop decision latency
+#                             (scheduled arrival → read done; p50/p99
+#                             in the bench serve_continuous record)
+#   serve_shed                counter — arrivals shed by the admission
+#                             token bucket / a failed seat
+SERVING_METRICS = (
+    "serving_admits",
+    "serving_admit_cold",
+    "serving_admit_resume",
+    "serving_admit_queued",
+    "serving_admit_failures",
+    "serving_appends",
+    "serving_append_events",
+    "serving_stale_appends",
+    "serving_gapped_appends",
+    "serving_ticks",
+    "serving_tick_seconds",
+    "serving_append_width",
+    "serving_events_replayed",
+    "serving_compose_failures",
+    "serving_lane_occupancy",
+    "serving_evictions",
+    "serving_recycles",
+    "serving_flush_failures",
+    "serving_resident_hits",
+    "serving_cold_misses",
+    "serving_cold_read_failures",
+    "serving_read_seconds",
+    "serve_decision",
+    "serve_shed",
+)
+
 # tracing plane self-telemetry (utils/tracing.py + utils/metrics.py),
 # tagged (layer=telemetry): traces_sampled counts sampled roots,
 # spans_recorded/spans_dropped account the flight-recorder ring buffer

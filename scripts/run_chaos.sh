@@ -31,6 +31,13 @@
 #       # every seed re-proves the forced+failback choreography
 #       # byte-identical to its fault-free baseline under the >=10%
 #       # write-fault storm, with conflicts_resolved >= 1
+#   CHAOS_SERVE=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # serving-engine sweep (TestServingChaos): the resident
+#       # megabatch under a >=10% write-fault storm on the
+#       # lane-eviction flush path — resident reads stay
+#       # byte-identical to the fault-free baseline, total flush
+#       # failure degrades to cold readmit from the history store,
+#       # and torn flush writes land + seed suffix-only resume seats
 #
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
@@ -50,6 +57,9 @@ if [[ -n "${CHAOS_SANITIZE:-}" ]]; then
 fi
 if [[ -n "${CHAOS_FAILOVER:-}" ]]; then
     FILTER=(-k "TestFailoverManagedHandover or TestFailoverRegionLossStorm")
+fi
+if [[ -n "${CHAOS_SERVE:-}" ]]; then
+    FILTER=(-k TestServingChaos)
 fi
 
 run_one() {

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Serving demo: boot Onebox with the continuous-batching resident
+# engine enabled, drive a short open-loop signal burst through the real
+# frontend, and prove resident hits + a clean drain on shutdown.
+#
+#   scripts/run_serve_demo.sh                      # default burst
+#   scripts/run_serve_demo.sh --qps 120 --requests 40
+#   scripts/run_serve_demo.sh --kind poisson       # poisson arrivals
+#
+# Exits non-zero unless resident hits >= requests - workflows (at most
+# one cold miss per workflow seats its lane; every later read answers
+# from the device-resident row with the Δ composed), the shutdown
+# drain flushes every lane through the checkpoint plane with zero
+# failures, and the engine is empty after. One JSON summary line lands
+# on stdout. Smoke-invoked from tests/test_serving.py so the wiring,
+# the demo and this script can't rot apart.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+exec python -m cadence_tpu.testing.serve_demo "$@"

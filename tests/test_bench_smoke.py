@@ -156,6 +156,30 @@ def test_smoke_emits_one_json_record():
         assert key in tel, f"telemetry_overhead lacks {key}"
     assert tel["untraced_calls_per_sec"] > 0
     assert tel["overhead_unsampled_frac"] <= 0.03, tel
+    # the continuous-batching serving contract (ISSUE 14): open-loop
+    # decision-latency SLOs come off the PR 9 histogram plane
+    # (Registry.timer_stats — p99 >= p50 > 0), the warm phase answers
+    # from resident lanes (hit rate > 0), and the O(Δ) pin holds —
+    # events the engine composed are the appended Δs (never more; shed
+    # arrivals skip their append), a small fraction of what a cold
+    # per-arrival rebuild of the same cohort would replay, and the
+    # shutdown drain flushes every lane cleanly
+    srv = out["configs"]["serve_continuous"]
+    for key in ("latency_p50_ms", "latency_p99_ms", "resident_hit_rate",
+                "qps_sustained", "events_appended", "events_replayed",
+                "events_per_append", "suffix_frac", "cold_events_equiv",
+                "drain_flush_failed"):
+        assert key in srv, f"serve_continuous lacks {key}"
+    assert srv["completed"] > 0, srv
+    assert srv["latency_p50_ms"] > 0, srv
+    assert srv["latency_p99_ms"] >= srv["latency_p50_ms"], srv
+    assert srv["resident_hit_rate"] > 0, srv
+    assert 0 < srv["events_replayed"] <= srv["events_appended"], srv
+    assert srv["suffix_frac"] < 0.5, (
+        "resident appends must be O(Δ), not a cold rebuild per arrival",
+        srv["suffix_frac"],
+    )
+    assert srv["drain_flush_failed"] == 0, srv
 
 
 def test_watchdog_still_yields_parseable_record():
@@ -180,6 +204,23 @@ def test_failing_probe_degrades_to_flagged_cpu_record():
     assert "backend_note" in out and "CPU fallback" in out["backend_note"]
     assert "error" not in out, out
     assert out["configs"]["retry_deep"]["histories_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_serve_continuous_degrades_to_cpu_fallback_record():
+    """The serving config under a dead accelerator probe: the open-loop
+    harness must still run on the CPU fallback and land its full SLO
+    record inside the flagged fallback JSON line — never a crash and
+    never a silently-missing config. slow-marked: a full extra smoke
+    bench invocation; the tier-1 failing-probe pin covers the shared
+    degrade ladder."""
+    out = _run({"BENCH_SMOKE": "1", "BENCH_SIM_PROBE_FAIL": "1"})
+    assert out["backend"]["platform"] == "cpu"
+    assert out["backend"]["fallback"] is True
+    assert "error" not in out, out
+    srv = out["configs"]["serve_continuous"]
+    assert srv["resident_hit_rate"] > 0, srv
+    assert srv["latency_p99_ms"] >= srv["latency_p50_ms"] > 0, srv
 
 
 @pytest.mark.slow

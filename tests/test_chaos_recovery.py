@@ -1745,3 +1745,202 @@ class TestReshardChaos:
             _RESHARD_WIDS, self._clean_histories(), chaos
         ):
             assert a == b, f"history for {wid} diverged after rollback"
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching serving chaos family (serving/engine.py)
+# (CHAOS_SERVE=1 sweeps this)
+# ---------------------------------------------------------------------------
+
+
+class TestServingChaos:
+    """The resident serving engine under the write-fault storm: the
+    checkpoint flush plane is ONLY an optimization — a ≥10% fault
+    storm on the flush path (and total flush failure, and torn flush
+    writes) must leave resident reads byte-identical to the fault-free
+    baseline, because the history store stays the source of truth and
+    a readmit cold-replays whatever the snapshot plane lost."""
+
+    def _seed_serving(self, bundle, n=4):
+        from cadence_tpu.ops import schema as S
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        caps = S.Capacities(max_events=256)
+        out = []
+        for i in range(n):
+            fz = HistoryFuzzer(seed=CHAOS_SEED + 7 * i, caps=caps)
+            batches = fz.generate(
+                target_events=30 + 10 * (i % 3), close=False
+            )
+            branch = bundle.history.new_history_branch(
+                tree_id=f"serve-run-{i}"
+            )
+            txn = 1
+            for b in batches:
+                bundle.history.append_history_nodes(
+                    branch, b, transaction_id=txn
+                )
+                txn += 1
+            out.append((
+                f"serve-wf-{i}", f"serve-run-{i}",
+                branch.to_json().encode(), batches,
+            ))
+        return caps, out
+
+    def _drive(self, engine, seeded):
+        """The serving choreography every arm replays identically:
+        seat a prefix, append the Δ suffix, tick, evict everyone (the
+        flush storm fires HERE), readmit from the store, read
+        resident. Returns {(wf, run): state_row}."""
+        from cadence_tpu.ops import schema as S  # noqa: F401
+
+        for wf, run, token, batches in seeded:
+            cut = max(1, len(batches) // 2)
+            t = engine.admit(
+                "dom", wf, run, branch_token=token,
+                batches=batches[:cut],
+            )
+            assert t is not None
+            rest = batches[cut:]
+            per = max(1, len(rest) // 2) if rest else 1
+            for j in range(0, len(rest), per):
+                assert engine.append(t, rest[j:j + per])
+        engine.tick()
+        for wf, run, _, _ in seeded:
+            assert engine.evict(wf, run)
+        assert engine.occupancy() == 0.0
+        rows = {}
+        for wf, run, token, _ in seeded:
+            t = engine.admit_from_store("dom", wf, run, token)
+            assert t is not None
+            got = engine.read(wf, run)
+            assert got is not None and got.resident
+            rows[(wf, run)] = got.state_row
+        return rows
+
+    @staticmethod
+    def _assert_rows_equal(got, want, msg=""):
+        import numpy as np
+
+        from cadence_tpu.ops import schema as S
+
+        for k in S.STATE_ROW_FIELDS:
+            np.testing.assert_array_equal(
+                got[k], want[k], err_msg=f"{msg} field {k}"
+            )
+
+    def _engine(self, bundle, caps, metrics=None):
+        from cadence_tpu.checkpoint import (
+            CheckpointManager,
+            CheckpointPolicy,
+        )
+        from cadence_tpu.serving import ResidentEngine
+
+        return ResidentEngine(
+            lanes=8, caps=caps,
+            checkpoints=CheckpointManager(
+                bundle.checkpoint,
+                CheckpointPolicy(every_events=1, keep_last=4),
+            ),
+            history=bundle.history, metrics=metrics,
+        )
+
+    @pytest.mark.slow
+    def test_flush_fault_storm_reads_byte_identical_to_baseline(self):
+        # slow-marked (two full drive arms): the CHAOS_SERVE=1 sweep
+        # runs it at every seed (--runslow); tier-1 keeps the
+        # single-arm total-flush-failure member below
+        # fault-free baseline arm
+        base_bundle = create_memory_bundle()
+        caps, base_seeded = self._seed_serving(base_bundle)
+        base_rows = self._drive(
+            self._engine(base_bundle, caps), base_seeded
+        )
+        # storm arm: same deterministic histories, ≥10% of every
+        # checkpoint-plane call (flush writes AND admit lookups) throws
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.checkpoint", probability=0.25,
+                      error="PersistenceError"),
+        ])
+        storm_bundle = wrap_bundle(
+            create_memory_bundle(), metrics=Scope(), faults=sched
+        )
+        _, storm_seeded = self._seed_serving(storm_bundle)
+        storm_rows = self._drive(
+            self._engine(storm_bundle, caps), storm_seeded
+        )
+        assert sched.injected_total() > 0, "the storm never happened"
+        assert base_rows.keys() == storm_rows.keys()
+        for key in base_rows:
+            self._assert_rows_equal(
+                storm_rows[key], base_rows[key], msg=f"storm {key}"
+            )
+
+    def test_total_flush_failure_degrades_to_cold_readmit(self):
+        """probability=1.0 on the flush write: every eviction loses its
+        snapshot. Readmits must cold-replay from history (zero resume
+        seats, zero stored checkpoints) and reads stay byte-identical
+        to a cold device rebuild of the full history."""
+        from cadence_tpu.ops import schema as S
+        from cadence_tpu.ops.pack import pack_lanes
+        from cadence_tpu.ops.replay import replay_packed_lanes
+
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.checkpoint",
+                      method="put_checkpoint", probability=1.0,
+                      error="PersistenceError"),
+        ])
+        bundle = wrap_bundle(
+            create_memory_bundle(), metrics=Scope(), faults=sched
+        )
+        caps, seeded = self._seed_serving(bundle)
+        scope = Scope()
+        engine = self._engine(bundle, caps, metrics=scope)
+        rows = self._drive(engine, seeded)
+        reg = scope.registry
+        assert reg.counter_value("serving_flush_failures") >= len(seeded)
+        assert reg.counter_value("serving_admit_resume") == 0
+        assert bundle.checkpoint.count_checkpoints() == 0
+        for wf, run, _, batches in seeded:
+            pk = pack_lanes([(wf, run, batches)], caps=caps)
+            want = S.state_row(replay_packed_lanes(pk), 0)
+            self._assert_rows_equal(
+                rows[(wf, run)], want, msg=f"cold {wf}"
+            )
+
+    @pytest.mark.slow
+    def test_torn_flush_lands_and_readmit_resumes(self):
+        """slow-marked (two full drive arms — see the storm member);
+        every CHAOS_SERVE=1 sweep seed runs it via --runslow.
+
+        torn_write on the flush: the snapshot LANDS while the ack is
+        lost (the idempotency reality). The flush counts as failed, but
+        the landed snapshot must seed the next admit suffix-only —
+        byte-identical reads with resume seats."""
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.checkpoint",
+                      method="put_checkpoint", probability=1.0,
+                      action="torn_write", error="TimeoutError"),
+        ])
+        bundle = wrap_bundle(
+            create_memory_bundle(), metrics=Scope(), faults=sched
+        )
+        caps, seeded = self._seed_serving(bundle)
+        scope = Scope()
+        engine = self._engine(bundle, caps, metrics=scope)
+        rows = self._drive(engine, seeded)
+        reg = scope.registry
+        assert bundle.checkpoint.count_checkpoints() >= len(seeded), (
+            "torn flush writes must land"
+        )
+        assert reg.counter_value("serving_admit_resume") == len(seeded)
+        # baseline arm: fault-free, same histories
+        base_bundle = create_memory_bundle()
+        _, base_seeded = self._seed_serving(base_bundle)
+        base_rows = self._drive(
+            self._engine(base_bundle, caps), base_seeded
+        )
+        for key in base_rows:
+            self._assert_rows_equal(
+                rows[key], base_rows[key], msg=f"torn {key}"
+            )

@@ -441,7 +441,12 @@ def _drive_sanitized_box(num_workflows=2):
 
     w = RaceWitness().install()
     try:
-        box = Onebox(num_shards=2, sanitize=True).start()
+        # serving=True: the resident engine's guarded lane table +
+        # admission queue must instantiate (and its lock edges be
+        # observed) under the same acceptance drive
+        box = Onebox(
+            num_shards=2, sanitize=True, checkpoints=True, serving=True
+        ).start()
         try:
             box.domain_handler.register_domain("san-dom")
             wkr = Worker(box.frontend, "san-dom", "san-tl",
@@ -474,6 +479,14 @@ def _drive_sanitized_box(num_workflows=2):
                         time.sleep(0.02)
                     else:
                         raise AssertionError(f"san-{i} did not complete")
+                # serving traffic: a cold miss seats a lane, the
+                # second read answers resident — the engine's lock
+                # edges land in the witness and cross-validate
+                # against the static graph
+                dom_id = box.domains.get_by_name("san-dom").info.id
+                for _ in range(2):
+                    got = box.history.serving_read(dom_id, "san-0")
+                    assert got is not None
             finally:
                 wkr.stop()
         finally:
