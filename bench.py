@@ -1354,6 +1354,157 @@ def _bench_serve_continuous(workflows: int, qps: float, lanes: int = 64,
     }
 
 
+def _bench_serve_overload(workflows: int, qps: float, lanes: int = 8,
+                          capacity_frac: float = 0.5, domains: int = 3,
+                          min_events: int = 20, max_events: int = 60,
+                          delta_batches: int = 3,
+                          tick_interval_ms: float = 5.0,
+                          staleness_bound_ms: float = 500.0):
+    """Graceful degradation under sustained overload (ISSUE 15).
+
+    Offers an open-loop Poisson stream at ``qps`` against a limiter
+    admitting only ``capacity_frac`` of it — sustained 1/capacity_frac×
+    overload (the default is 2×). Workloads spread over ``domains``
+    weighted domains through the fair-admission engine; rejected
+    arrivals re-offer through a success-refilled RetryBudget; a
+    background TickPump bounds resident staleness. The record reports
+    the degradation ladder's observables: ``shed_frac`` (> 0 at 2× —
+    excess load is shed, not queued into the p99), per-domain p99 +
+    progress counters (no starvation), ``staleness_p99_ms`` vs the
+    bound, and goodput vs offered."""
+    import random as _random
+
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.serving import (
+        AdmissionPolicy,
+        ArrivalProcess,
+        OpenLoopHarness,
+        ResidentEngine,
+        ServeWorkload,
+        TickPump,
+    )
+    from cadence_tpu.testing import workloads as W
+    from cadence_tpu.utils.metrics import Scope
+    from cadence_tpu.utils.quotas import (
+        MultiStageRateLimiter,
+        RetryBudget,
+    )
+
+    caps = S.Capacities(
+        max_events=512, max_activities=2, max_timers=2,
+        max_children=2, max_request_cancels=2, max_signals_ext=4,
+        max_version_items=2)
+    dom_names = [f"dom-{d}" for d in range(domains)]
+
+    def build(tag):
+        rng = _random.Random(52)
+        loads = []
+        for i in range(workflows):
+            batches = W.signal_history(
+                rng, min_events=min_events, max_events=max_events)
+            cut = max(1, int(len(batches) * 0.4))
+            deltas = [
+                batches[k : k + delta_batches]
+                for k in range(cut, len(batches), delta_batches)
+            ]
+            loads.append(ServeWorkload(
+                domain_id=dom_names[i % domains],
+                workflow_id=f"ovl-{tag}-wf-{i}",
+                run_id=f"ovl-{tag}-run-{i}", branch_token=b"",
+                prefix=batches[:cut], deltas=deltas,
+            ))
+        return loads
+
+    def drive(tag, scope):
+        loads = build(tag)
+        engine = ResidentEngine(
+            lanes=lanes, caps=caps, metrics=scope, idle_ticks=2,
+            admission=AdmissionPolicy(
+                domain_weights={
+                    d: float(2 ** (domains - i))
+                    for i, d in enumerate(dom_names)
+                },
+                quota_rps=qps, aging_boost=1.0,
+            ),
+        )
+        capacity = qps * capacity_frac
+        harness = OpenLoopHarness(
+            engine, loads,
+            ArrivalProcess(qps=qps, seed=11),
+            metrics=scope,
+            limiter=MultiStageRateLimiter(
+                global_rps=capacity,
+                domain_rps=lambda d: capacity,
+                global_burst=max(4, int(capacity / 8)),
+            ),
+            retry_budget=RetryBudget(ratio=0.2, cap=16.0, initial=8.0),
+        )
+        pump = TickPump(
+            engine, tick_interval_ms / 1e3, metrics=scope
+        ).start()
+        try:
+            run = harness.run()
+        finally:
+            pump.stop()
+        return run, engine
+
+    from cadence_tpu.utils.metrics import NOOP as _NOOP
+
+    drive("warm", _NOOP)[1].drain()  # jit warm round, own registry
+    scope = Scope()
+    reg = scope.registry
+    run, engine = drive("run", scope)
+    drained = engine.drain()
+
+    per_domain = {}
+    for d in dom_names:
+        stats = reg.timer_stats(
+            "serve_decision",
+            tags={"layer": "serving_harness", "domain": d},
+        )
+        prog = run["domains"].get(d, {})
+        per_domain[d] = {
+            "completed": prog.get("completed", 0),
+            "shed": prog.get("shed", 0),
+            "retries": prog.get("retries", 0),
+            "p99_ms": round(stats.p99 * 1e3, 3),
+        }
+    stats = reg.timer_stats("serve_decision")
+    staleness = reg.timer_stats("serving_staleness_ms")
+    starvation = reg.timer_stats("serving_admit_starvation_age_ms")
+    wall = max(run["wall_s"], 1e-9)
+    return {
+        "workflows": workflows,
+        "lanes": lanes,
+        "domains": domains,
+        "qps_offered_target": round(qps, 1),
+        "capacity_frac": capacity_frac,
+        "requests": run["requests"],
+        "offered": run["offered"],
+        "retries": run["retries"],
+        "completed": run["completed"],
+        "shed": run["shed"],
+        "shed_frac": round(run["shed"] / max(run["requests"], 1), 4),
+        "offered_amplification": round(
+            run["offered"] / max(run["requests"], 1), 3),
+        "goodput_qps": round(run["completed"] / wall, 1),
+        "offered_qps": round(run["offered"] / wall, 1),
+        "latency_p50_ms": round(stats.p50 * 1e3, 3),
+        "latency_p99_ms": round(stats.p99 * 1e3, 3),
+        "per_domain": per_domain,
+        "staleness_p99_ms": round(staleness.p99, 3),
+        "staleness_bound_ms": staleness_bound_ms,
+        "staleness_in_bound": bool(
+            staleness.p99 <= staleness_bound_ms
+        ),
+        "starvation_age_max_ms": round(starvation.max_s, 3),
+        "retry_budget_exhausted": reg.counter_value(
+            "retry_budget_exhausted"
+        ),
+        "drain_flush_failed": drained["flush_failed"],
+    }
+
+
 def _bench_telemetry_overhead(calls: int = 30000, rounds: int = 5):
     """Unsampled telemetry cost on the instrumented serving path.
 
@@ -1937,6 +2088,11 @@ def main() -> None:
         # (cadence_tpu/serving/; README "Continuous-batching serving")
         "serve_continuous": dict(serve=dict(
             workflows=48, qps=300.0, lanes=64)),
+        # graceful degradation under sustained 2x overload: fair
+        # admission + retry budgets + the tick pump's staleness bound
+        # (ISSUE 15; README "Overload control")
+        "serve_overload": dict(overload=dict(
+            workflows=24, qps=400.0, lanes=8, capacity_frac=0.5)),
         # unsampled telemetry cost on the instrumented serving path:
         # the ≤3% guard tests/test_bench_smoke.py pins (utils/tracing)
         "telemetry_overhead": dict(telemetry=dict(
@@ -1980,6 +2136,12 @@ def main() -> None:
             "serve_continuous": dict(serve=dict(
                 workflows=6, qps=120.0, lanes=8,
                 min_events=20, max_events=48)),
+            # overload JSON contract: 2x offered load over a tiny
+            # capacity bucket — shed_frac > 0, every domain progresses,
+            # staleness stays bounded, all at seconds scale
+            "serve_overload": dict(overload=dict(
+                workflows=9, qps=150.0, lanes=4, capacity_frac=0.5,
+                min_events=16, max_events=32)),
             # the ≤3% unsampled-tracing guard at smoke scale. The
             # min-over-paired-rounds estimator needs ONE clean pair;
             # shorter rounds shrink the per-pair window a host stall
@@ -2041,6 +2203,13 @@ def main() -> None:
         elif "serve" in cfg:
             try:
                 results[config] = _bench_serve_continuous(**cfg["serve"])
+            except Exception as e:
+                results[config] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        elif "overload" in cfg:
+            try:
+                results[config] = _bench_serve_overload(**cfg["overload"])
             except Exception as e:
                 results[config] = {
                     "error": f"{type(e).__name__}: {str(e)[:200]}"

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
+from cadence_tpu.runtime.api import ServiceBusyError
 from cadence_tpu.runtime.controller import (
     ShardController,
     ShardOwnershipLostError,
@@ -20,6 +21,8 @@ from cadence_tpu.runtime.controller import (
 from cadence_tpu.runtime.persistence.errors import (
     ShardOwnershipLostError as PersistenceShardOwnershipLost,
 )
+from cadence_tpu.utils.metrics import NOOP, Scope
+from cadence_tpu.utils.quotas import RetryBudget
 
 # Bounded ownership-lost retry (reference retryableClient.go): every
 # attempt re-resolves through the controllers, so a shard mid-move —
@@ -31,12 +34,30 @@ _OWNERSHIP_RETRY = 6
 _OWNERSHIP_BACKOFF_S = 0.05
 _OWNERSHIP_BACKOFF_MAX_S = 1.0
 
+# ServiceBusy retries are BUDGETED, not merely bounded (ISSUE 15): a
+# saturated server shedding load must not see every rejection come
+# straight back N more times — that multiplies the overload it is
+# shedding. The budget refills on successes, so a healthy client
+# retries transient sheds freely while a client facing sustained
+# overload converges to ~offered × (1 + ratio).
+_BUSY_RETRY = 3
+_BUSY_BACKOFF_MAX_S = 2.0
+
 
 def _ownership_backoff_s(attempt: int, rng=random) -> float:
     base = min(
         _OWNERSHIP_BACKOFF_S * (2 ** (attempt - 1)), _OWNERSHIP_BACKOFF_MAX_S
     )
     return base * rng.uniform(0.5, 1.5)
+
+
+def _busy_backoff_s(e: ServiceBusyError, attempt: int) -> float:
+    """Honor the shed response's retry-after hint; fall back to the
+    ownership backoff schedule when the server sent none."""
+    hint = getattr(e, "retry_after_s", 0.0) or 0.0
+    if hint > 0:
+        return min(hint, _BUSY_BACKOFF_MAX_S)
+    return _ownership_backoff_s(attempt)
 
 
 class HistoryClient:
@@ -47,10 +68,20 @@ class HistoryClient:
     deployment passes one controller.
     """
 
-    def __init__(self, controllers) -> None:
+    def __init__(
+        self,
+        controllers,
+        retry_budget: Optional[RetryBudget] = None,
+        metrics: Scope = NOOP,
+    ) -> None:
         if isinstance(controllers, ShardController):
             controllers = {controllers.identity: controllers}
         self._controllers: Dict[str, ShardController] = dict(controllers)
+        # per-client ServiceBusy retry budget (token bucket refilled by
+        # successes); pass a shared instance to make several clients
+        # share one budget, or None for the default
+        self.retry_budget = retry_budget or RetryBudget()
+        self._client_metrics = metrics.tagged(layer="client")
 
     def add_host(self, controller: ShardController) -> None:
         self._controllers[controller.identity] = controller
@@ -70,6 +101,30 @@ class HistoryClient:
         raise last_err or ShardOwnershipLostError(-1, "<unknown>")
 
     def _call(self, workflow_id: str, method: str, *args, **kwargs):
+        """Dispatch under the ServiceBusy retry budget: a shed response
+        (retryable, carries retry-after) is re-offered after its hint
+        — but each re-offer WITHDRAWS a budget token, and the budget
+        refills only on successes. Exhausted budget (or attempts) ⇒
+        the shed surfaces to the caller; ``retry_budget_exhausted``
+        counts the former — the retry-storm breaker observable."""
+        attempt = 0
+        while True:
+            try:
+                out = self._call_inner(
+                    workflow_id, method, *args, **kwargs
+                )
+                self.retry_budget.record_success()
+                return out
+            except ServiceBusyError as e:
+                attempt += 1
+                if attempt > _BUSY_RETRY:
+                    raise
+                if not self.retry_budget.can_retry():
+                    self._client_metrics.inc("retry_budget_exhausted")
+                    raise
+                time.sleep(_busy_backoff_s(e, attempt))
+
+    def _call_inner(self, workflow_id: str, method: str, *args, **kwargs):
         """Resolve + invoke under a bounded ownership-lost retry: BOTH
         shapes — the controller's (no local handle) and the persistence
         rangeID-fencing sibling raised mid-call by a fenced/stolen

@@ -132,12 +132,17 @@ class RoutedHistoryClient(HistoryClient):
         monitor: Monitor,
         local_controller=None,
         num_shards: Optional[int] = None,
+        retry_budget=None,
+        metrics=None,
     ) -> None:
         from cadence_tpu.rpc.client import RemoteHistory
+        from cadence_tpu.utils.metrics import NOOP
 
         super().__init__(
             {} if local_controller is None
-            else {local_controller.identity: local_controller}
+            else {local_controller.identity: local_controller},
+            retry_budget=retry_budget,
+            metrics=metrics if metrics is not None else NOOP,
         )
         self.monitor = monitor
         self.local = local_controller
@@ -171,7 +176,12 @@ class RoutedHistoryClient(HistoryClient):
             )(*args, **kwargs)
         return getattr(self._stubs.get(owner), method)(*args, **kwargs)
 
-    def _call(self, workflow_id: str, method: str, *args, **kwargs):
+    def _call_inner(self, workflow_id: str, method: str, *args, **kwargs):
+        # the ownership/transport retry layer; the ServiceBusy retry
+        # BUDGET lives above it in HistoryClient._call — a shed
+        # response is deliberately NOT in is_routed_retryable, or the
+        # unbudgeted transport retry would amplify the very overload
+        # the server is shedding
         return retry(
             _traced_attempts(
                 lambda: self._call_once(workflow_id, method, *args,

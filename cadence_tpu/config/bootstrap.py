@@ -241,6 +241,27 @@ def start_services(
         persistence.metadata, cluster_metadata
     )
 
+    # overload control (ISSUE 15): service-level limiters beyond the
+    # frontend's. Domain rates read the dynamicconfig property per
+    # call, so a file-watched edit takes effect live; the defaults are
+    # effectively-unlimited (the limiter then never sheds)
+    from cadence_tpu.utils.quotas import MultiStageRateLimiter
+
+    history_domain_rps = dyncfg.float_property(
+        "history.domainRps", 100000.0
+    )
+    history_limiter = MultiStageRateLimiter(
+        global_rps=dyncfg.float_property("history.rps", 100000.0)(),
+        domain_rps=lambda _d: history_domain_rps(),
+    )
+    matching_domain_rps = dyncfg.float_property(
+        "matching.domainRps", 100000.0
+    )
+    matching_limiter = MultiStageRateLimiter(
+        global_rps=dyncfg.float_property("matching.rps", 100000.0)(),
+        domain_rps=lambda _d: matching_domain_rps(),
+    )
+
     history = None
     if "history" in services:
         history = HistoryService(
@@ -255,6 +276,7 @@ def start_services(
             metrics=metrics,
             checkpoints=checkpoints,
             serving=serving,
+            rate_limiter=history_limiter,
         )
         # admin reshard verbs read the section off the service
         history.resharding_config = cfg.resharding
@@ -267,13 +289,18 @@ def start_services(
         monitor,
         history.controller if history else None,
         num_shards=cfg.persistence.num_history_shards,
+        # the host scope: retry_budget_exhausted (layer=client) — the
+        # retry-storm breaker firing — must land in the registry
+        # operators actually scrape, not NOOP
+        metrics=metrics,
     )
     out.history_client = hc
 
     matching = None
     if "matching" in services:
         matching = MatchingEngine(
-            persistence.task, hc, config=dyncfg, metrics=metrics
+            persistence.task, hc, config=dyncfg, metrics=metrics,
+            rate_limiter=matching_limiter,
         )
         out.matching = matching
     mc = RoutedMatchingClient(

@@ -199,12 +199,67 @@ class ServingConfig:
     enabled: bool = False
     lanes: int = 64
     idle_ticks: int = 256
+    # overload control plane (ISSUE 15): the fair-admission refill —
+    # per-domain base weights (missing domains use defaultWeight),
+    # a per-domain refill quota (tokens/sec + burst; 0 = unmetered),
+    # the deadline-aging boost (priority per refill round parked —
+    # the starvation-free guarantee), and the age at which an aged bid
+    # bypasses its domain quota entirely
+    domain_weights: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+    default_weight: float = 1.0
+    quota_rps: float = 0.0
+    quota_burst: int = 0
+    aging_boost: float = 1.0
+    starvation_recycles: int = 8
+    # the background tick pump's cadence (ms); 0 disables the pump and
+    # ticks ride reads/appends as before — write-heavy lanes then have
+    # no staleness bound. NOTE: with a pump, ``idleTicks`` acquires a
+    # wall-clock meaning — an untouched lane evicts after roughly
+    # idleTicks × tickIntervalMs, so size the pair together
+    tick_interval_ms: float = 0.0
 
     def validate(self) -> None:
+        # validation is INLINE (mirroring AdmissionPolicy.validate) on
+        # purpose: importing cadence_tpu.serving here would pull jax
+        # into every process that merely loads a config — including
+        # frontend/matching-only hosts that never build an engine
         if self.lanes < 1:
             raise ConfigError("serving.lanes must be >= 1")
         if self.idle_ticks < 1:
             raise ConfigError("serving.idleTicks must be >= 1")
+        if self.tick_interval_ms < 0:
+            raise ConfigError("serving.tickIntervalMs must be >= 0")
+        if self.default_weight <= 0:
+            raise ConfigError("serving.defaultWeight must be > 0")
+        for dom, w in self.domain_weights.items():
+            if w <= 0:
+                raise ConfigError(
+                    f"serving.domainWeights['{dom}'] must be > 0"
+                )
+        if self.quota_rps < 0 or self.quota_burst < 0:
+            raise ConfigError("serving: negative quota")
+        if self.aging_boost <= 0:
+            raise ConfigError("serving.agingBoost must be > 0")
+        if self.starvation_recycles < 1:
+            raise ConfigError(
+                "serving.starvationRecycles must be >= 1"
+            )
+
+    def _admission_policy(self):
+        from cadence_tpu.serving import AdmissionPolicy
+
+        policy = AdmissionPolicy(
+            domain_weights=dict(self.domain_weights),
+            default_weight=self.default_weight,
+            quota_rps=self.quota_rps,
+            quota_burst=self.quota_burst,
+            aging_boost=self.aging_boost,
+            starvation_recycles=self.starvation_recycles,
+        )
+        policy.validate()
+        return policy
 
     def build_engine(self, checkpoints=None, history=None, metrics=None):
         """The ResidentEngine this section describes, or None when
@@ -219,6 +274,8 @@ class ServingConfig:
         return ResidentEngine(
             lanes=self.lanes, idle_ticks=self.idle_ticks,
             checkpoints=checkpoints, history=history, metrics=metrics,
+            admission=self._admission_policy(),
+            tick_interval_s=self.tick_interval_ms / 1e3,
         )
 
 
@@ -467,6 +524,13 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "enabled": "enabled",
             "lanes": "lanes",
             "idleTicks": "idle_ticks",
+            "domainWeights": "domain_weights",
+            "defaultWeight": "default_weight",
+            "quotaRps": "quota_rps",
+            "quotaBurst": "quota_burst",
+            "agingBoost": "aging_boost",
+            "starvationRecycles": "starvation_recycles",
+            "tickIntervalMs": "tick_interval_ms",
         }, "serving"))
 
     rsh = raw.pop("resharding", None)

@@ -87,7 +87,19 @@ class WorkflowHandler:
         if len(domain_name) > _MAX_ID_LENGTH:
             raise BadRequestError("domain name too long")
         if not self.limiter.allow(domain_name):
-            raise ServiceBusyError(f"domain {domain_name} rate limit")
+            # shed with a retry-after hint (the bucket's refill
+            # horizon) so well-behaved clients pace their re-offer
+            # instead of hammering a saturated frontend; counted under
+            # tags (service=frontend, domain=...) — the overload
+            # dashboard's per-tenant shed rate
+            self.metrics.tagged(domain=domain_name).inc(
+                "frontend_requests_shed"
+            )
+            hint = getattr(self.limiter, "retry_after_s", None)
+            raise ServiceBusyError(
+                f"domain {domain_name} rate limit",
+                retry_after_s=hint(domain_name) if hint else 0.0,
+            )
         try:
             rec = self.domains.get_by_name(domain_name)
         except PersistenceEntityNotExistsError:

@@ -21,6 +21,7 @@ from cadence_tpu.runtime.api import (
     EntityNotExistsServiceError,
     PollForActivityTaskResponse,
     PollForDecisionTaskResponse,
+    ServiceBusyError,
 )
 from cadence_tpu.runtime.persistence.interfaces import TaskManager
 from cadence_tpu.runtime.persistence.records import TaskInfo
@@ -59,6 +60,7 @@ class MatchingEngine:
         time_source: Optional[TimeSource] = None,
         metrics: Scope = NOOP,
         poll_request_id_fn=None,
+        rate_limiter=None,
     ) -> None:
         self._store = task_manager
         self._history = history_client
@@ -95,6 +97,11 @@ class MatchingEngine:
             "matching.numTasklistReadPartitions", 1
         )
         self._tasklist_rps = cfg.float_property("matching.rps", 100000.0)
+        # overload control (ISSUE 15): a MultiStageRateLimiter over
+        # task ADDS (polls stay unmetered — a parked poller is the
+        # backpressure, not the overload). None (the default) is one
+        # attribute read per add
+        self.rate_limiter = rate_limiter
         # in-flight sync queries: query_id → (event, result slot)
         self._query_lock = make_lock("MatchingEngine._query_lock")
         self._pending_queries: Dict[str, tuple] = make_guarded(
@@ -167,6 +174,16 @@ class MatchingEngine:
     def _add_task(
         self, domain_id: str, name: str, task_type: int, info: TaskInfo
     ) -> bool:
+        lim = self.rate_limiter
+        if lim is not None and not lim.allow(domain_id):
+            # retryable shed: the queue processor's at-least-once
+            # retry re-offers the task after the hint — coordinated
+            # backpressure instead of unbounded task-list growth
+            hint = getattr(lim, "retry_after_s", None)
+            raise ServiceBusyError(
+                f"matching overloaded (domain {domain_id})",
+                retry_after_s=hint(domain_id) if hint else 0.0,
+            )
         part = self._pick_partition(domain_id, name, write=True)
         mgr = self._get_manager(TaskListID(domain_id, part, task_type))
         return mgr.add_task(info)

@@ -168,7 +168,12 @@ class HistoryRPCServer(ServiceRPCServer):
     ) -> None:
         from cadence_tpu.client.history import HistoryClient
 
-        local = HistoryClient(history_service.controller)
+        # share the service's metrics scope so the client-layer
+        # retry_budget_exhausted counter is observable on this host
+        local = HistoryClient(
+            history_service.controller,
+            metrics=history_service.metrics,
+        )
         super().__init__(
             HISTORY_SERVICE, [local, history_service], address,
             max_workers, server=server,

@@ -56,7 +56,14 @@ class InternalServiceError(ServiceError):
 
 
 class ServiceBusyError(ServiceError):
-    pass
+    """Rate limit / overload shed. RETRYABLE: carries a
+    ``retry_after_s`` hint (derived from the rejecting bucket's refill
+    horizon or the admission queue depth) so clients back off for the
+    right interval instead of hammering a saturated stage."""
+
+    def __init__(self, msg: str = "", retry_after_s: float = 0.0) -> None:
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 # -- requests -------------------------------------------------------------

@@ -44,6 +44,7 @@ from ..api import (
     DescribeWorkflowResponse,
     EntityNotExistsServiceError,
     InternalServiceError,
+    ServiceBusyError,
     SignalRequest,
     SignalWithStartRequest,
     StartWorkflowRequest,
@@ -102,8 +103,29 @@ class HistoryEngine:
         # queue processors poke these after each persisted transaction
         self._task_notifier = task_notifier or (lambda: None)
         self._timer_notifier = timer_notifier or (lambda: None)
+        # overload control (ISSUE 15): a MultiStageRateLimiter wired by
+        # HistoryService — None (the default) costs one attribute read.
+        # The frontend's limiter alone cannot protect this layer: queue
+        # processors, replication appliers, and cross-shard calls all
+        # reach the engine without passing a frontend
+        self.rate_limiter = None
 
     # -- helpers ------------------------------------------------------
+
+    def _shed_check(self, domain_key: str, op: str) -> None:
+        """Coordinated shedding: consult the service-level limiter and
+        shed with the RETRYABLE ``ServiceBusyError`` (retry-after hint
+        = the rejecting bucket's refill horizon) — clients spend their
+        retry budget instead of stacking work on a saturated shard."""
+        lim = self.rate_limiter
+        if lim is None:
+            return
+        if not lim.allow(domain_key):
+            hint = getattr(lim, "retry_after_s", None)
+            raise ServiceBusyError(
+                f"history overloaded ({op}, domain {domain_key})",
+                retry_after_s=hint(domain_key) if hint else 0.0,
+            )
 
     def _domain_version(self, domain_record) -> int:
         return (
@@ -259,6 +281,7 @@ class HistoryEngine:
     ) -> str:
         """Returns the new run_id (reference historyEngine.go:408)."""
         request.validate()
+        self._shed_check(request.domain, "start_workflow_execution")
         domain = (
             self.domains.get_by_id(domain_id)
             if domain_id
@@ -375,6 +398,7 @@ class HistoryEngine:
 
     def signal_workflow_execution(self, request: SignalRequest) -> None:
         request.validate()
+        self._shed_check(request.domain, "signal_workflow_execution")
         domain = self.domains.get_by_name(request.domain)
         version = self._domain_version(domain)
 

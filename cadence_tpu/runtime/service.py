@@ -46,6 +46,7 @@ class HistoryService:
         queue_exhausted_retry_delay_s: Optional[float] = None,
         checkpoints=None,
         serving=None,
+        rate_limiter=None,
     ) -> None:
         from cadence_tpu.utils.metrics import Scope
 
@@ -81,6 +82,14 @@ class HistoryService:
         # answer from the resident row with the Δ composed. None =
         # every serving read is a cold rebuild
         self.serving = serving
+        # overload control (ISSUE 15): a MultiStageRateLimiter every
+        # owned shard's engine consults on ingress writes — sheds with
+        # the retryable ServiceBusyError + retry-after. None = never
+        # shed at this layer (the frontend's limiter still applies)
+        self.rate_limiter = rate_limiter
+        # the serving tick pump (serving/pump.py), started when the
+        # engine carries a configured cadence (serving.tickIntervalMs)
+        self._tick_pump = None
         # config.ReshardingConfig (`resharding:` section) — read by the
         # admin reshard verbs; None = defaults (enabled)
         self.resharding_config = None
@@ -122,8 +131,25 @@ class HistoryService:
         if self.matching_client is None or self.history_client is None:
             raise RuntimeError("HistoryService.wire() must be called first")
         self.controller.acquire_shards()
+        if (self.serving is not None
+                and getattr(self.serving, "tick_interval_s", 0) > 0):
+            from cadence_tpu.serving.pump import TickPump
+
+            # bounded staleness: the pump composes write-heavy lanes'
+            # persist-feed debt at the configured cadence even with
+            # zero read traffic (serving_staleness_ms is the proof)
+            self._tick_pump = TickPump(
+                self.serving, self.serving.tick_interval_s,
+                metrics=self.metrics,
+            ).start()
 
     def stop(self) -> None:
+        if self._tick_pump is not None:
+            # pump drain-on-stop FIRST: its final tick composes Δs
+            # staged since the last cycle, so the lane flush below
+            # writes tip-accurate snapshots
+            self._tick_pump.stop()
+            self._tick_pump = None
         if self.serving is not None:
             # flush every resident lane back through the checkpoint
             # plane before the shards go away (clean drain: the next
@@ -145,6 +171,7 @@ class HistoryService:
         engine.faults = self.faults
         engine.checkpoints = self.checkpoints
         engine.serving = self.serving
+        engine.rate_limiter = self.rate_limiter
         engine.matching_client = self.matching_client
         has_standby = bool(self.standby_clusters)
         transfer = TransferQueueProcessor(

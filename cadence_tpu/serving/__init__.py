@@ -4,22 +4,31 @@ O(Δ) replay-on-append.
 ``ResidentEngine`` (engine.py) owns a fixed-shape resident state tensor
 of S lanes and applies per-append suffix compositions in one fused
 device step per tick — LLM-style continuous batching for workflow
-replay. ``harness.py`` is the open-loop SLO load harness (Poisson /
-bursty arrival processes at sustained QPS through token buckets).
+replay. ``admission.py`` is the fair admission scheduler (weighted +
+deadline-aged + per-domain-quota'd refill of freed lanes). ``pump.py``
+is the background tick pump bounding resident-row staleness for
+write-heavy lanes. ``harness.py`` is the open-loop SLO load harness
+(Poisson / bursty arrival processes at sustained QPS through token
+buckets, with retry-budgeted re-offers of shed arrivals).
 """
 
+from .admission import AdmissionPolicy, FairAdmissionQueue
 from .engine import (
     LaneTicket,
     ResidentEngine,
     ResidentRead,
 )
 from .harness import ArrivalProcess, OpenLoopHarness, ServeWorkload
+from .pump import TickPump
 
 __all__ = [
+    "AdmissionPolicy",
     "ArrivalProcess",
+    "FairAdmissionQueue",
     "LaneTicket",
     "OpenLoopHarness",
     "ResidentEngine",
     "ResidentRead",
     "ServeWorkload",
+    "TickPump",
 ]

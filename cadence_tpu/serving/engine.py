@@ -58,6 +58,10 @@ from cadence_tpu.core.events import HistoryEvent
 from cadence_tpu.ops import schema as S
 from cadence_tpu.ops.grid import round_scan_len
 from cadence_tpu.ops.pack import ResumeState, pack_lanes
+from cadence_tpu.serving.admission import (
+    AdmissionPolicy,
+    FairAdmissionQueue,
+)
 from cadence_tpu.utils import locks
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP, Scope
@@ -140,6 +144,10 @@ class _Lane:
     # advanced to this next_event_id; the next tick fetches the
     # [next_staged, behind_through) suffix — O(Δ) — and stages it
     behind_through: int = 0
+    # wall time the lane FIRST went dirty (staged Δ or persist debt)
+    # since its last compose — the ``serving_staleness_ms`` input the
+    # tick pump's bounded-staleness contract is asserted against
+    dirty_since: float = 0.0
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -172,6 +180,8 @@ class ResidentEngine:
         metrics: Optional[Scope] = None,
         idle_ticks: int = 256,
         affine_types: Optional[frozenset] = None,
+        admission: Optional[AdmissionPolicy] = None,
+        tick_interval_s: float = 0.0,
     ) -> None:
         if lanes < 1:
             raise ValueError("serving: lanes must be >= 1")
@@ -210,9 +220,14 @@ class ResidentEngine:
         self._by_key = locks.make_guarded(
             {}, "ResidentEngine._by_key", self._lock
         )
-        self._admit_queue = locks.make_guarded(
-            [], "ResidentEngine._admit_queue", self._lock
-        )
+        # fair admission (serving/admission.py): weighted + deadline-
+        # aged + per-domain-quota'd refill, replacing the PR 14 FIFO
+        # list; the queue's parked table is guarded by THIS engine lock
+        self._admit_queue = FairAdmissionQueue(admission, self._lock)
+        # the tick pump's cadence (serving/pump.py; 0 = no pump): the
+        # engine just carries the configured value for whoever owns the
+        # pump thread (HistoryService.start)
+        self.tick_interval_s = float(tick_interval_s)
         self._slot_gen = [0] * self.lanes
         self._tick_no = 0
         # the resident store: one [S]-row StateTensors, rows scattered
@@ -262,13 +277,16 @@ class ResidentEngine:
             batches=self._read_batches(branch_token),
         )
 
-    def admit_many(self, requests: Sequence[Dict]) -> Dict:
+    def admit_many(self, requests: Sequence[Dict], _requeued=None) -> Dict:
         """Bulk admission; returns {(workflow_id, run_id): ticket|None}.
 
         Free lanes are reserved under the lock, then every seat replay
         runs as ONE batch through the existing dispatcher
         (``replay_stream`` — pack overlap, depth bucketing, grid
-        shapes), and the rows commit back under the lock."""
+        shapes), and the rows commit back under the lock. ``_requeued``
+        (internal, the refill path): key → the original parked entry,
+        so an admission that fails to seat re-parks at its ORIGINAL
+        age — re-queueing must never reset the starvation clock."""
         admissions = [self._prepare_admission(r) for r in requests]
         out: Dict = {}
         seat: List[Tuple[int, int, _Admission]] = []
@@ -286,7 +304,10 @@ class ResidentEngine:
                     continue
                 free = self._free_slot()
                 if free is None:
-                    self._admit_queue.append(adm)
+                    self._admit_queue.park(
+                        adm,
+                        requeued_from=(_requeued or {}).get(adm.key),
+                    )
                     queued += 1
                     out[adm.key] = None
                     continue
@@ -487,6 +508,8 @@ class ResidentEngine:
                                 lane.behind_through,
                                 b[-1].event_id + 1,
                             )
+                            if not lane.dirty_since:
+                                lane.dirty_since = _time.monotonic()
                             continue
                         gapped = True
                         break
@@ -494,6 +517,8 @@ class ResidentEngine:
                     lane.pending_events += len(b)
                     n_events += len(b)
                     lane.next_staged = b[-1].event_id + 1
+                    if not lane.dirty_since:
+                        lane.dirty_since = _time.monotonic()
         if stale:
             self._metrics.inc("serving_stale_appends")
             return False
@@ -529,6 +554,8 @@ class ResidentEngine:
             # the workflow's NEXT durable write (possibly never); the
             # post-seat catch-up heals the recorded span instead
             lane.behind_through = max(lane.behind_through, next_event_id)
+            if not lane.dirty_since:
+                lane.dirty_since = _time.monotonic()
             if not running:
                 # close hint: once the debt composes (the close events
                 # are in it), the committed row confirms and the
@@ -713,6 +740,7 @@ class ResidentEngine:
             )
             groups["scan" if non else "auto"].append(item)
         composed = replayed = failures = stale = 0
+        staleness_ms: List[float] = []
         for mode, items in groups.items():
             if not items:
                 continue
@@ -766,6 +794,20 @@ class ResidentEngine:
                     self._commit_row(slot, lane, packed, final, j)
                     composed += 1
                     replayed += sum(len(b) for b in batches)
+                    if lane.dirty_since:
+                        # staleness: first-dirty → composed. Reset to
+                        # "now" (not 0) when Δs staged mid-compose —
+                        # their clock started while this step ran
+                        now = _time.monotonic()
+                        staleness_ms.append(
+                            (now - lane.dirty_since) * 1e3
+                        )
+                        lane.dirty_since = now if (
+                            lane.pending
+                            or lane.behind_through > lane.next_staged
+                        ) else 0.0
+        for ms in staleness_ms:
+            self._metrics.record("serving_staleness_ms", ms)
         return composed, replayed, failures, stale
 
     # ------------------------------------------------------------------
@@ -795,20 +837,24 @@ class ResidentEngine:
         recycled = 0
         # refill whenever a free slot exists — slots freed by seat/
         # compose failures or an explicit evict() (not just this tick's
-        # evictions) must not starve parked admissions; admit_many
-        # re-queues whatever still doesn't fit
+        # evictions) must not starve parked admissions. The refill
+        # order is the fair scheduler's (weighted + deadline-aged +
+        # per-domain quotas): only as many admissions as there are free
+        # slots are taken, and a take that fails to seat re-parks at
+        # its original age
         with self._lock:
-            has_free = any(s is None for s in self._slots)
+            n_free = sum(1 for s in self._slots if s is None)
             backlog = (
-                list(self._admit_queue)
-                if has_free and self._admit_queue else []
+                self._admit_queue.take(n_free) if n_free else []
             )
-            if backlog:
-                del self._admit_queue[:]
+            ages_ms = [
+                self._admit_queue.parked_age_s(e) * 1e3 for e in backlog
+            ]
         if backlog:
             # store reads + the bulk admission run OUTSIDE the lock
             reqs = []
-            for a in backlog:
+            for entry in backlog:
+                a = entry.adm
                 batches = a.batches
                 if self.history is not None and a.branch_token:
                     try:
@@ -825,10 +871,36 @@ class ResidentEngine:
                     workflow_id=a.workflow_id, run_id=a.run_id,
                     branch_token=a.branch_token, batches=batches,
                 ))
-            readmitted = self.admit_many(reqs)
+            readmitted = self.admit_many(
+                reqs,
+                _requeued={e.adm.key: e for e in backlog},
+            )
             recycled = sum(
                 1 for t in readmitted.values() if t is not None
             )
+            # a taken admission whose SEAT REPLAY failed was dropped by
+            # admit_many (only the no-free-slot branch re-parks): put
+            # it back at its original age so a transient fault storm
+            # cannot eat a parked admission's starvation guarantee —
+            # bounded attempts so a genuinely poisoned history drops
+            # after 3 tries (readmit-from-read stays its recovery path)
+            with self._lock:
+                for entry in backlog:
+                    if (readmitted.get(entry.adm.key) is None
+                            and entry.attempts < 3
+                            and not self._admit_queue.has_key(
+                                entry.adm.key)):
+                        self._admit_queue.park(
+                            entry.adm, requeued_from=entry
+                        )
+            # the parked-age distribution at seat time: the starvation
+            # observable TestOverloadChaos bounds (aging guarantees a
+            # seat within K recycles for any weight assignment)
+            for ms, entry in zip(ages_ms, backlog):
+                if readmitted.get(entry.adm.key) is not None:
+                    self._metrics.record(
+                        "serving_admit_starvation_age_ms", ms
+                    )
         return len(flush), recycled, flush_failed
 
     def _flush_row(self, lane: _Lane, row: Dict) -> bool:
@@ -1087,8 +1159,7 @@ class ResidentEngine:
                     continue
                 flush.append((lane, S.state_row(self._state, slot)))
                 self._release_slot(slot, lane.key)
-            queued = len(self._admit_queue)
-            del self._admit_queue[:]
+            queued = self._admit_queue.drain()
         failed = 0
         for lane, row in flush:
             if not self._flush_row(lane, row):

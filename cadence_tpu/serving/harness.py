@@ -10,24 +10,34 @@ falling behind shows up as queueing delay in the p99, exactly as it
 would for real users.
 
 Admission rides the existing token buckets (``utils/quotas``): a
-request the bucket rejects counts as shed load, not latency.
+request the bucket rejects counts as shed load, not latency. The
+overload control plane (ISSUE 15) adds the domain-aware shape: a
+``MultiStageRateLimiter`` admits per (domain, global) budget, and a
+rejected arrival may RE-OFFER itself after the limiter's retry-after
+hint — but only while the ``RetryBudget`` (success-refilled) has
+tokens, so the harness reproduces exactly the client discipline that
+keeps total offered load bounded instead of amplifying the overload.
+Latency for a retried arrival still counts from its ORIGINAL scheduled
+time — retries are honest queueing delay, not a fresh clock.
 
 Per-arrival shape (the serving hot path): ``append(Δ)`` → engine tick
 (all due arrivals in one fused step — continuous batching) →
 ``read()``; the decision latency histogram lands in the PR 9
 exponential-bucket registry (``Registry.timer_stats``), which is where
-the reported p50/p99 come from.
+the reported p50/p99 come from (tagged ``domain=`` so per-domain p99
+is one ``timer_stats(tags=...)`` away).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import random
 import time as _time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from cadence_tpu.utils.metrics import NOOP, Scope
-from cadence_tpu.utils.quotas import TokenBucket
+from cadence_tpu.utils.quotas import RetryBudget, TokenBucket
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +125,15 @@ class OpenLoopHarness:
     continuous batch), and each request's read completes it. Latency
     is recorded scheduled-arrival → read-complete into
     ``metrics.timer("serve_decision")``.
+
+    Overload controls (all optional, all off by default):
+
+    * ``admission_bucket`` — the PR 14 single token bucket;
+    * ``limiter`` — a ``MultiStageRateLimiter``: per-domain + global
+      admission, shed responses carry its retry-after hint;
+    * ``retry_budget`` — a ``RetryBudget``: a rejected arrival
+      re-offers itself at now + retry-after while the budget holds;
+      exhausted, it sheds permanently (``retry_budget_exhausted``).
     """
 
     def __init__(
@@ -124,6 +143,8 @@ class OpenLoopHarness:
         process: ArrivalProcess,
         metrics: Optional[Scope] = None,
         admission_bucket: Optional[TokenBucket] = None,
+        limiter=None,
+        retry_budget: Optional[RetryBudget] = None,
         clock: Callable[[], float] = _time.monotonic,
         sleep: Callable[[float], None] = _time.sleep,
         max_wait_s: float = 0.25,
@@ -135,6 +156,8 @@ class OpenLoopHarness:
             metrics if metrics is not None else NOOP
         ).tagged(layer="serving_harness")
         self.bucket = admission_bucket
+        self.limiter = limiter
+        self.retry_budget = retry_budget
         self._clock = clock
         self._sleep = sleep
         self._max_wait_s = max_wait_s
@@ -157,6 +180,27 @@ class OpenLoopHarness:
             b for d in w.deltas[: k + 1] for b in d
         ]
 
+    # -- admission controls --------------------------------------------
+
+    def _admitted(self, domain_id: str) -> bool:
+        if self.limiter is not None and not self.limiter.allow(domain_id):
+            return False
+        if self.bucket is not None and not self.bucket.allow():
+            return False
+        return True
+
+    def _retry_after_s(self, domain_id: str) -> float:
+        hint = 0.0
+        if self.limiter is not None:
+            hint = self.limiter.retry_after_s(domain_id)
+        elif self.bucket is not None:
+            get = getattr(self.bucket, "retry_after_s", None)
+            if get is not None:
+                hint = get()
+        # floor at one mean inter-arrival so a zero hint cannot busy-
+        # spin the re-offer against a still-saturated bucket
+        return max(hint, 1.0 / self.process.qps)
+
     def run(self) -> Dict:
         """The open-loop drive; returns the run's SLO stats."""
         tickets = self.admit_all()
@@ -170,26 +214,68 @@ class OpenLoopHarness:
                 if k < len(w.deltas):
                     order.append((w, w.deltas[k], k))
         schedule = self.process.schedule(len(order))
+        # the live arrival queue: (due time, seq, arrival index).
+        # Retries re-push the same index at now + retry-after; latency
+        # ALWAYS measures from schedule[i], the original arrival
+        heap: List[Tuple[float, int, int]] = [
+            (schedule[i], i, i) for i in range(len(order))
+        ]
+        seq = len(order)
         t_start = self._clock()
-        shed = completed = 0
-        latencies_recorded = 0
-        i = 0
-        while i < len(order):
+        shed = completed = retries = 0
+        offered = len(order)
+        domains: Dict[str, Dict[str, int]] = {}
+
+        def dom_stats(d: str) -> Dict[str, int]:
+            s = domains.get(d)
+            if s is None:
+                s = domains[d] = {
+                    "completed": 0, "shed": 0, "retries": 0,
+                }
+            return s
+
+        def reject(i: int, w: ServeWorkload, now: float) -> None:
+            """One rejection — limiter shed, failed seat, or a lane
+            lost between append and read: re-offer at now + the
+            retry-after hint while the budget holds, else shed
+            permanently. Python's closure-over-nonlocal keeps the
+            three call sites honest about the same accounting."""
+            nonlocal shed, retries, offered, seq
+            self.metrics.inc("serve_shed")
+            budget = self.retry_budget
+            if budget is not None and budget.can_retry():
+                retries += 1
+                offered += 1
+                dom_stats(w.domain_id)["retries"] += 1
+                seq += 1
+                heapq.heappush(heap, (
+                    now + self._retry_after_s(w.domain_id), seq, i,
+                ))
+            else:
+                if budget is not None:
+                    self.metrics.inc("retry_budget_exhausted")
+                shed += 1
+                dom_stats(w.domain_id)["shed"] += 1
+
+        while heap:
             now = self._clock() - t_start
-            if schedule[i] > now:
+            if heap[0][0] > now:
                 self._sleep(
-                    min(schedule[i] - now, self._max_wait_s)
+                    min(heap[0][0] - now, self._max_wait_s)
                 )
                 continue
             # continuous batch: every arrival due by now appends first,
             # then ONE tick composes all of them
             due: List[Tuple[int, ServeWorkload]] = []
-            while i < len(order) and schedule[i] <= now:
+            processed = 0
+            while heap and heap[0][0] <= now:
+                processed += 1
+                _, _, i = heapq.heappop(heap)
                 w, delta, k = order[i]
-                if self.bucket is not None and not self.bucket.allow():
-                    shed += 1
-                    self.metrics.inc("serve_shed")
-                    i += 1
+                if not self._admitted(w.domain_id):
+                    # shed-then-retry: back off by the limiter's hint,
+                    # re-offer at the same arrival index
+                    reject(i, w, now)
                     continue
                 key = (w.workflow_id, w.run_id)
                 t = tickets.get(key)
@@ -221,34 +307,56 @@ class OpenLoopHarness:
                 else:
                     ok = True
                 if not ok:
-                    shed += 1
-                    self.metrics.inc("serve_shed")
-                    i += 1
+                    # every lane occupied: the admission parked in the
+                    # engine's fair queue — the arrival re-offers and
+                    # meets its seated lane at a later refill
+                    reject(i, w, now)
                     continue
                 due.append((i, w))
-                i += 1
             if not due:
+                if processed:
+                    # rejected-only round: still drive one tick so
+                    # eviction + fair-queue refill progress — an
+                    # all-parked cohort would otherwise livelock
+                    # (no completion → no tick → no refill → every
+                    # re-offer parks again, forever)
+                    self.engine.tick()
                 continue
             self.engine.tick()
             for j, w in due:
                 got = self.engine.read(w.workflow_id, w.run_id)
                 t_read = self._clock() - t_start
-                assert got is not None, (
-                    f"resident read lost {w.workflow_id}"
-                )
+                if got is None:
+                    # the LRU recycled this lane between the arrival's
+                    # append and its read (aggressive idle horizons
+                    # under overload churn — the re-seat ticks of
+                    # OTHER arrivals in the same batch age it out):
+                    # the arrival re-offers like any shed, its Δ
+                    # duplicate-trims on the healed lane
+                    reject(j, w, self._clock() - t_start)
+                    continue
                 # open-loop latency: scheduled arrival → read done
-                # (queueing delay from falling behind is IN the number)
-                self.metrics.record(
+                # (queueing delay from falling behind — and retry
+                # backoff — is IN the number)
+                self.metrics.tagged(domain=w.domain_id).record(
                     "serve_decision", t_read - schedule[j]
                 )
-                latencies_recorded += 1
                 completed += 1
+                dom_stats(w.domain_id)["completed"] += 1
+                if self.retry_budget is not None:
+                    self.retry_budget.record_success()
         wall = self._clock() - t_start
         return {
             "requests": len(order),
             "completed": completed,
             "shed": shed,
+            "retries": retries,
+            # total offered load = arrivals + retries: the retry-budget
+            # boundedness observable (offered / requests stays near 1 +
+            # budget even under sustained rejection)
+            "offered": offered,
             "wall_s": wall,
             "qps_sustained": completed / wall if wall > 0 else 0.0,
             "qps_target": self.process.qps,
+            "domains": domains,
         }

@@ -1,0 +1,100 @@
+"""Background tick pump: bounded staleness for write-heavy lanes.
+
+PR 14's ticks ride reads and appends — a write-heavy, read-light
+workflow could stage persist-feed debt forever without a reader to
+compose it, so its resident row's staleness was unbounded (the ROADMAP
+follow-on this closes). The pump is one daemon thread driving
+``ResidentEngine.tick()`` at a configured cadence
+(``serving.tickIntervalMs``), so every dirty lane composes within
+~one interval regardless of read traffic; the proof is the
+``serving_staleness_ms`` histogram the engine records per composed lane
+(first-dirty → composed), which TestOverloadChaos holds under the
+configured bound.
+
+Discipline:
+
+* **drain-on-stop**: ``stop()`` joins the thread and runs ONE final
+  tick so Δs staged between the last cycle and the stop are composed
+  before HistoryService.drain flushes the lanes;
+* **fault-tolerant**: the tick calls through the engine into the
+  (possibly ``wrap_bundle``-fault-injected) history manager — an
+  injected or real error must not kill the pump. A failed cycle logs,
+  counts ``serving_tick_pump_errors``, and backs off (doubling, capped
+  at 8× the cadence) so a down store is not hammered at full cadence;
+* **no locks held while sleeping**: the pump owns no lock at all; the
+  engine's own ``_tick_lock`` serializes it against inline tick
+  callers (reads composing dirty lanes) exactly like any other caller.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cadence_tpu.utils.log import get_logger
+from cadence_tpu.utils.metrics import NOOP, Scope
+
+
+class TickPump:
+    """Drives ``engine.tick()`` every ``interval_s`` until stopped."""
+
+    def __init__(
+        self,
+        engine,
+        interval_s: float,
+        metrics: Scope = None,
+        name: str = "serving-tick-pump",
+    ) -> None:
+        if interval_s <= 0:
+            raise ValueError("tick pump: interval_s must be > 0")
+        self.engine = engine
+        self.interval_s = float(interval_s)
+        self._metrics = (
+            metrics if metrics is not None else NOOP
+        ).tagged(layer="serving")
+        self._log = get_logger("cadence_tpu.serving.pump")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._started = False
+        self.cycles = 0
+        self.errors = 0
+
+    def start(self) -> "TickPump":
+        self._started = True
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        delay = self.interval_s
+        while not self._stop.wait(delay):
+            try:
+                self.engine.tick()
+                self.cycles += 1
+                delay = self.interval_s
+            except Exception as e:
+                # a sick store must not kill the staleness bound for
+                # good — log, count, back off (capped), keep pumping
+                self.errors += 1
+                self._metrics.inc("serving_tick_pump_errors")
+                self._log.warn(f"tick pump cycle failed ({e}); backoff")
+                delay = min(delay * 2.0, self.interval_s * 8.0)
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Drain-on-stop: join the pump, then one final tick composes
+        whatever was staged after the last cycle."""
+        if not self._started:
+            return
+        self._stop.set()
+        self._thread.join(timeout_s)
+        try:
+            self.engine.tick()
+            self.cycles += 1
+        except Exception as e:
+            self.errors += 1
+            self._metrics.inc("serving_tick_pump_errors")
+            self._log.warn(f"tick pump drain tick failed ({e})")
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive()

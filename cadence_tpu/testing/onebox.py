@@ -107,7 +107,9 @@ class Onebox:
             checkpoints=self.checkpoints,
             serving=self.serving,
         )
-        self.history_client = HistoryClient(self.history.controller)
+        self.history_client = HistoryClient(
+            self.history.controller, metrics=self.metrics
+        )
         # the clock and the poll nonce are the two entropy sources a
         # deterministic chaos run must pin: matching shares history's
         # time source, and poll_request_id_fn replaces the per-poll

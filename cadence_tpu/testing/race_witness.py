@@ -83,11 +83,13 @@ GUARDED_FIELDS: Dict[str, str] = {
     "Registry._gauges": "Registry._lock",
     "Registry._timers": "Registry._lock",
     # continuous-batching serving engine (cadence_tpu/serving/): the
-    # lane table, key index, and admission queue all ride ONE lock;
-    # packing/device steps/flushes never run while it is held
+    # lane table, key index, and the fair-admission parked table all
+    # ride ONE lock (the engine's — FairAdmissionQueue never acquires,
+    # its callers hold the guard); packing/device steps/flushes never
+    # run while it is held
     "ResidentEngine._slots": "ResidentEngine._lock",
     "ResidentEngine._by_key": "ResidentEngine._lock",
-    "ResidentEngine._admit_queue": "ResidentEngine._lock",
+    "FairAdmissionQueue._parked": "ResidentEngine._lock",
 }
 
 

@@ -270,6 +270,16 @@ DEVICE_METRICS = (
 #                             in the bench serve_continuous record)
 #   serve_shed                counter — arrivals shed by the admission
 #                             token bucket / a failed seat
+#   serving_admit_starvation_age_ms histogram — how long a parked
+#                             admission waited before the fair refill
+#                             seated it (deadline aging bounds the p100:
+#                             TestOverloadChaos's no-starvation proof)
+#   serving_staleness_ms      histogram — first-dirty → composed per
+#                             lane; the tick pump holds its p99 under
+#                             the configured staleness bound even for
+#                             write-heavy/read-light lanes
+#   serving_tick_pump_errors  counter — pump cycles that failed (the
+#                             pump logs, backs off capped, keeps going)
 SERVING_METRICS = (
     "serving_admits",
     "serving_admit_cold",
@@ -295,6 +305,23 @@ SERVING_METRICS = (
     "serving_read_seconds",
     "serve_decision",
     "serve_shed",
+    "serving_admit_starvation_age_ms",
+    "serving_staleness_ms",
+    "serving_tick_pump_errors",
+)
+
+# overload control plane (ISSUE 15), emitted by the layers that shed
+# or give up: frontend_requests_shed counts frontend rate-limit
+# rejections under tags (service=frontend, domain=...) — each carries
+# a retry-after hint on the ServiceBusyError; retry_budget_exhausted
+# counts the moments a client's success-refilled retry budget denied a
+# ServiceBusy re-offer (layer=client) or the open-loop harness's
+# simulated client did the same (layer=serving_harness) — the
+# retry-storm breaker firing, i.e. load that was offered once and NOT
+# multiplied.
+OVERLOAD_METRICS = (
+    "frontend_requests_shed",
+    "retry_budget_exhausted",
 )
 
 # tracing plane self-telemetry (utils/tracing.py + utils/metrics.py),

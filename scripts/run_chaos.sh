@@ -38,6 +38,15 @@
 #       # byte-identical to the fault-free baseline, total flush
 #       # failure degrades to cold readmit from the history store,
 #       # and torn flush writes land + seed suffix-only resume seats
+#   CHAOS_OVERLOAD=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # overload sweep (TestOverloadChaos): sustained 2x-capacity
+#       # Poisson + bursty storms through the open-loop harness with
+#       # the write-fault storm underneath — zero domain starvation,
+#       # admitted-traffic p99 in bound while excess sheds,
+#       # shed-then-retried workflows byte-identical to the
+#       # uncontended baseline, retry budgets keep offered load
+#       # bounded, and the tick pump holds serving_staleness_ms p99
+#       # under the configured bound
 #
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
@@ -60,6 +69,9 @@ if [[ -n "${CHAOS_FAILOVER:-}" ]]; then
 fi
 if [[ -n "${CHAOS_SERVE:-}" ]]; then
     FILTER=(-k TestServingChaos)
+fi
+if [[ -n "${CHAOS_OVERLOAD:-}" ]]; then
+    FILTER=(-k TestOverloadChaos)
 fi
 
 run_one() {

@@ -180,6 +180,30 @@ def test_smoke_emits_one_json_record():
         srv["suffix_frac"],
     )
     assert srv["drain_flush_failed"] == 0, srv
+    # the overload-control contract (ISSUE 15): at 2x offered load the
+    # degradation ladder engages — a real shed fraction (excess load is
+    # rejected, not queued into the p99), per-domain progress counters
+    # prove zero starvation under weighted fair admission, the retry
+    # budget keeps offered-load amplification bounded, and the tick
+    # pump holds resident staleness under the configured bound
+    ovl = out["configs"]["serve_overload"]
+    for key in ("shed_frac", "offered_amplification", "goodput_qps",
+                "latency_p50_ms", "latency_p99_ms", "per_domain",
+                "staleness_p99_ms", "staleness_bound_ms",
+                "staleness_in_bound", "retries",
+                "retry_budget_exhausted", "drain_flush_failed"):
+        assert key in ovl, f"serve_overload lacks {key}"
+    assert ovl["shed_frac"] > 0, (
+        "2x offered load must shed", ovl,
+    )
+    for dom, rec in ovl["per_domain"].items():
+        assert rec["completed"] > 0, (
+            f"domain {dom} starved under overload", ovl["per_domain"],
+        )
+    # budget boundedness: offered = arrivals + budgeted retries only
+    assert ovl["offered"] == ovl["requests"] + ovl["retries"], ovl
+    assert ovl["staleness_in_bound"] is True, ovl
+    assert ovl["drain_flush_failed"] == 0, ovl
 
 
 def test_watchdog_still_yields_parseable_record():
@@ -221,6 +245,15 @@ def test_serve_continuous_degrades_to_cpu_fallback_record():
     srv = out["configs"]["serve_continuous"]
     assert srv["resident_hit_rate"] > 0, srv
     assert srv["latency_p99_ms"] >= srv["latency_p50_ms"] > 0, srv
+    # the overload config's CPU-fallback degrade pin: the full record
+    # (shed + fairness + staleness observables) still lands in the
+    # flagged fallback JSON line — never a crash, never missing
+    ovl = out["configs"]["serve_overload"]
+    assert ovl["shed_frac"] > 0, ovl
+    assert all(
+        rec["completed"] > 0 for rec in ovl["per_domain"].values()
+    ), ovl
+    assert ovl["staleness_in_bound"] is True, ovl
 
 
 @pytest.mark.slow

@@ -1944,3 +1944,476 @@ class TestServingChaos:
             self._assert_rows_equal(
                 rows[key], base_rows[key], msg=f"torn {key}"
             )
+
+
+class TestOverloadChaos:
+    """Graceful degradation under sustained overload (ISSUE 15): the
+    open-loop harness offers 2× the admitted capacity (Poisson and
+    bursty storms) against the fair-admission engine with the ≥10%
+    write-fault storm underneath. The bar: every domain makes progress
+    (no starvation), admitted-traffic p99 stays in bound while the
+    excess is shed, shed-then-retried workflows converge byte-identical
+    to an uncontended baseline, retry budgets keep total offered load
+    bounded, and the tick pump holds serving_staleness_ms under the
+    configured staleness bound."""
+
+    DOMAINS = ("dom-a", "dom-b", "dom-c")
+
+    class _Clock:
+        """Virtual clock shared by the harness, the limiter buckets
+        and the admission quotas — deterministic overload in
+        milliseconds of wall time."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+        def sleep(self, dt):
+            self.t += max(dt, 1e-6)
+
+    def _loads(self, n=9, seed=None, deltas=3):
+        from cadence_tpu.ops import schema as S
+        from cadence_tpu.runtime.persistence.records import BranchToken
+        from cadence_tpu.serving import ServeWorkload
+        from cadence_tpu.testing.event_generator import HistoryFuzzer
+
+        caps = S.Capacities(max_events=256)
+        loads = []
+        for i in range(n):
+            fz = HistoryFuzzer(
+                seed=(seed if seed is not None else CHAOS_SEED) + 31 * i,
+                caps=caps,
+            )
+            batches = fz.generate(
+                target_events=24 + 8 * (i % 3), close=False
+            )
+            cut = max(1, len(batches) // 2)
+            rest = batches[cut:]
+            per = max(1, len(rest) // deltas) if rest else 1
+            loads.append(ServeWorkload(
+                domain_id=self.DOMAINS[i % len(self.DOMAINS)],
+                workflow_id=f"ovl-wf-{i}", run_id=f"ovl-run-{i}",
+                # a real branch token: the eviction/recycle churn then
+                # flushes through the (fault-wrapped) checkpoint plane
+                # — the write-fault storm's landing site
+                branch_token=BranchToken(
+                    tree_id=f"ovl-run-{i}", branch_id=f"ovl-br-{i}"
+                ).to_json().encode(),
+                prefix=batches[:cut],
+                deltas=[
+                    rest[j:j + per] for j in range(0, len(rest), per)
+                ],
+            ))
+        return caps, loads
+
+    def _engine(self, caps, clock, scope=None, lanes=4, bundle=None):
+        from cadence_tpu.checkpoint import (
+            CheckpointManager,
+            CheckpointPolicy,
+        )
+        from cadence_tpu.serving import AdmissionPolicy, ResidentEngine
+
+        kw = {}
+        if bundle is not None:
+            kw = dict(
+                checkpoints=CheckpointManager(
+                    bundle.checkpoint,
+                    CheckpointPolicy(every_events=1, keep_last=2),
+                ),
+            )
+        engine = ResidentEngine(
+            lanes=lanes, caps=caps, metrics=scope, idle_ticks=2,
+            admission=AdmissionPolicy(
+                domain_weights={
+                    "dom-a": 8.0, "dom-b": 2.0, "dom-c": 0.5,
+                },
+                quota_rps=200.0, quota_burst=4,
+                aging_boost=1.0, starvation_recycles=6,
+            ),
+            **kw,
+        )
+        # the fair queue's quota buckets must ride the virtual clock
+        engine._admit_queue._clock = clock
+        return engine
+
+    def _drive(self, kind, caps, loads, scope, bundle=None,
+               capacity_frac=0.5, qps=200.0, budget=None):
+        from cadence_tpu.serving import ArrivalProcess, OpenLoopHarness
+        from cadence_tpu.utils.quotas import (
+            MultiStageRateLimiter,
+            RetryBudget,
+        )
+
+        clock = self._Clock()
+        engine = self._engine(caps, clock, scope=scope, bundle=bundle)
+        capacity = qps * capacity_frac
+        harness = OpenLoopHarness(
+            engine, loads,
+            ArrivalProcess(qps=qps, kind=kind, seed=CHAOS_SEED),
+            metrics=scope,
+            limiter=MultiStageRateLimiter(
+                global_rps=capacity, domain_rps=lambda d: capacity,
+                clock=clock, global_burst=4,
+            ),
+            # effectively unbounded on purpose: THESE members prove
+            # CONVERGENCE of shed-then-retried work (every rejection
+            # re-offers until it lands, so byte-identity is meaningful
+            # for every workload); the dedicated budget member below
+            # proves the bounded-offered-load half with a starved
+            # budget — at sustained 2x a finite budget rightfully
+            # collapses and sheds the excess permanently
+            retry_budget=(
+                budget if budget is not None
+                else RetryBudget(ratio=0.0, cap=1e9, initial=1e9)
+            ),
+            clock=clock, sleep=clock.sleep,
+        )
+        out = harness.run()
+        return out, engine
+
+    def _storm_bundle(self):
+        """The ≥10% write-fault storm: every checkpoint-plane write the
+        eviction/recycle churn produces can throw."""
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.checkpoint", probability=0.2,
+                      error="PersistenceError"),
+        ])
+        return wrap_bundle(
+            create_memory_bundle(), metrics=Scope(), faults=sched
+        ), sched
+
+    def _assert_rows_match_cold(self, engine, loads, caps, msg):
+        """Every workload — including every shed-then-retried one —
+        must converge byte-identical to its uncontended baseline (a
+        cold full-history replay). Workloads evicted by the lane churn
+        re-seat one at a time (their flushed/faulted checkpoints may
+        resume-seed or cold-replay; both must land the same bytes)."""
+        import numpy as np
+
+        from cadence_tpu.ops import schema as S
+        from cadence_tpu.ops.pack import pack_lanes
+        from cadence_tpu.ops.replay import replay_packed_lanes
+
+        for w in loads:
+            full = list(w.prefix) + [b for d in w.deltas for b in d]
+            # evict first: admit dedups by key, and a lane still seated
+            # from the run would answer at ITS tip instead of seating
+            # the full history
+            engine.evict(w.workflow_id, w.run_id)
+            engine.admit(
+                w.domain_id, w.workflow_id, w.run_id,
+                branch_token=w.branch_token, batches=full,
+            )
+            got = engine.read(w.workflow_id, w.run_id)
+            assert got is not None, f"{msg}: {w.workflow_id} lost"
+            pk = pack_lanes(
+                [(w.workflow_id, w.run_id, full)], caps=caps
+            )
+            want = S.state_row(replay_packed_lanes(pk), 0)
+            for k in S.STATE_ROW_FIELDS:
+                np.testing.assert_array_equal(
+                    got.state_row[k], want[k],
+                    err_msg=f"{msg} {w.workflow_id} field {k}",
+                )
+            engine.evict(w.workflow_id, w.run_id)
+
+    def test_sustained_2x_poisson_degrades_gracefully(self):
+        """The headline member: 2× offered load, write-fault storm on
+        the flush plane, generous retry budget. Every domain completes,
+        admitted p99 stays in bound, every rejection is observable, and
+        every shed-then-retried workflow converges byte-identical to
+        the uncontended (cold full-replay) state."""
+        bundle, sched = self._storm_bundle()
+        try:
+            caps, loads = self._loads()
+            scope = Scope()
+            out, engine = self._drive(
+                "poisson", caps, loads, scope, bundle=bundle
+            )
+            reg = scope.registry
+            # the storm happened and the excess was rejected
+            assert sched.injected_total() > 0, "storm never fired"
+            assert reg.counter_value("serve_shed") > 0, (
+                "2x load never tripped the limiter"
+            )
+            assert out["retries"] > 0
+            # no starvation: every domain completed work
+            for d in self.DOMAINS:
+                assert out["domains"].get(d, {}).get("completed", 0) > 0, (
+                    f"domain {d} starved: {out['domains']}"
+                )
+            # the generous budget converged the whole offered set
+            assert out["completed"] == out["requests"], out
+            assert out["shed"] == 0
+            # admitted-traffic p99 in bound: shedding + retry backoff
+            # keep the queueing delay bounded (virtual-clock seconds;
+            # the bound is ~2 arrival windows of the retried tail)
+            stats = reg.timer_stats("serve_decision")
+            assert stats.count == out["requests"]
+            assert stats.p99 < 2.0, (
+                f"admitted p99 {stats.p99:.3f}s out of bound"
+            )
+            # the fair refill ran and recorded its starvation ages —
+            # bounded by aging (well under the virtual run length)
+            starv = reg.timer_stats("serving_admit_starvation_age_ms")
+            if starv.count:
+                assert starv.max_s < 2000.0
+            # shed-then-retried workflows byte-identical to uncontended
+            self._assert_rows_match_cold(
+                engine, loads, caps, "2x-poisson"
+            )
+        finally:
+            bundle.close()
+
+    @pytest.mark.slow
+    def test_bursty_storm_all_domains_progress(self):
+        """The thundering-herd arrival shape at 2× capacity: bursts
+        shed harder, but fairness still feeds every domain and the
+        converged rows stay byte-identical. slow-marked: the Poisson
+        member keeps the same invariants under tier-1 wall clock; the
+        CHAOS_OVERLOAD=1 sweep runs this one at every seed
+        (--runslow)."""
+        bundle, sched = self._storm_bundle()
+        try:
+            caps, loads = self._loads(seed=CHAOS_SEED + 7)
+            scope = Scope()
+            out, engine = self._drive(
+                "bursty", caps, loads, scope, bundle=bundle
+            )
+            reg = scope.registry
+            assert reg.counter_value("serve_shed") > 0
+            for d in self.DOMAINS:
+                assert out["domains"].get(d, {}).get("completed", 0) > 0
+            assert out["completed"] == out["requests"]
+            assert reg.timer_stats("serve_decision").p99 < 3.0
+            self._assert_rows_match_cold(
+                engine, loads, caps, "bursty"
+            )
+        finally:
+            bundle.close()
+
+    def test_retry_budget_bounds_offered_load(self):
+        """Deny-everything limiter + a finite, success-starved budget:
+        total offered load is requests + budget — the retry storm
+        cannot amplify. The exhaustion is observable."""
+        from cadence_tpu.serving import ArrivalProcess, OpenLoopHarness
+        from cadence_tpu.utils.quotas import RetryBudget
+
+        class _DenyAll:
+            def allow(self, domain=""):
+                return False
+
+            def retry_after_s(self, domain=""):
+                return 0.02
+
+        caps, loads = self._loads(n=3)
+        clock = self._Clock()
+        scope = Scope()
+        engine = self._engine(caps, clock, scope=scope)
+        budget = RetryBudget(ratio=0.0, cap=8.0, initial=5.0)
+        harness = OpenLoopHarness(
+            engine, loads, ArrivalProcess(qps=100.0, seed=CHAOS_SEED),
+            metrics=scope, limiter=_DenyAll(), retry_budget=budget,
+            clock=clock, sleep=clock.sleep,
+        )
+        out = harness.run()
+        assert out["completed"] == 0
+        assert out["retries"] == 5  # exactly the seeded budget
+        assert out["offered"] == out["requests"] + 5
+        assert out["shed"] == out["requests"]
+        assert (
+            scope.registry.counter_value("retry_budget_exhausted") >= 1
+        )
+
+    def test_tick_pump_bounds_staleness_under_write_storm(self):
+        """Write-heavy/read-light: events reach lanes ONLY through the
+        persist feed, reads never drive ticks — the pump alone must
+        compose the debt. A ≥10% fault storm on the catch-up's history
+        reads stretches individual cycles; the staleness p99 must stay
+        under the bound anyway, and the final rows must be
+        byte-identical to the store's full history.
+
+        Determinism discipline: the workload is built from FIXED-SHAPE
+        chunks (2 signals + one decision cycle = 5 events, constant
+        type set) and every compose is pinned to the sequential
+        fallback, so the executable set is exactly {k chunks → one
+        span-width grid bucket} — the warm phase compiles ALL of them
+        up front and jit time can never masquerade as staleness (the
+        hybrid auto split is proven byte-identical in
+        tests/test_serving.py; this member measures the pump)."""
+        import numpy as np
+
+        from cadence_tpu.core import history_factory as F
+        from cadence_tpu.ops import schema as S
+        from cadence_tpu.ops.pack import pack_lanes
+        from cadence_tpu.ops.replay import replay_packed_lanes
+        from cadence_tpu.serving import ResidentEngine, TickPump
+
+        caps = S.Capacities(max_events=256)
+        SECOND = 1_000_000_000
+        CHUNKS = 8
+
+        def build_workload():
+            """(prefix batches, chunk list); every chunk is the same
+            5-event shape so any contiguous chunk span has the same
+            type signature."""
+            eid = [0]
+            t = [1_700_000_000 * SECOND]
+
+            def nxt():
+                eid[0] += 1
+                return eid[0]
+
+            def tick():
+                t[0] += SECOND
+                return t[0]
+
+            v = 10
+
+            def cycle():
+                sch = nxt()
+                out = [[F.decision_task_scheduled(sch, v, t[0])]]
+                sta = nxt()
+                out.append([F.decision_task_started(
+                    sta, v, tick(), scheduled_event_id=sch,
+                )])
+                out.append([F.decision_task_completed(
+                    nxt(), v, tick(), scheduled_event_id=sch,
+                    started_event_id=sta,
+                )])
+                return out
+
+            prefix = [[F.workflow_execution_started(
+                nxt(), v, t[0], task_list="tl", workflow_type="pump",
+                execution_start_to_close_timeout_seconds=3600,
+                task_start_to_close_timeout_seconds=10,
+            )]]
+            prefix += cycle()
+            chunks = []
+            for n in range(CHUNKS):
+                c = [
+                    [F.workflow_execution_signaled(
+                        nxt(), v, tick(), signal_name=f"s{n}-{j}",
+                    )]
+                    for j in range(2)
+                ]
+                c += cycle()
+                chunks.append(c)
+            return prefix, chunks
+
+        prefix, chunks = build_workload()
+        full_batches = list(prefix) + [b for c in chunks for b in c]
+
+        # warm phase: compile every executable the measured round can
+        # touch — the seat shape, and one compose per chunk-span width
+        # (a fault-stalled catch-up composes up to ALL CHUNKS chunks in
+        # one step, so every k is reachable)
+        warm_engine = ResidentEngine(
+            lanes=2, caps=caps, affine_types=frozenset(),
+        )
+        for k in range(1, CHUNKS + 1):
+            t = warm_engine.admit(
+                "dom", f"warm-wf-{k}", f"warm-run-{k}", batches=prefix
+            )
+            assert t is not None
+            assert warm_engine.append(
+                t, [b for c in chunks[:k] for b in c]
+            )
+            warm_engine.tick()
+            assert warm_engine.evict(f"warm-wf-{k}", f"warm-run-{k}")
+
+        sched = FaultSchedule(seed=CHAOS_SEED, rules=[
+            FaultRule(site="persistence.history",
+                      method="read_history_branch", probability=0.15,
+                      error="PersistenceError"),
+        ])
+        bundle = wrap_bundle(
+            create_memory_bundle(), metrics=Scope(), faults=sched
+        )
+        try:
+            scope = Scope()
+            engine = ResidentEngine(
+                lanes=4, caps=caps, history=bundle.history,
+                metrics=scope, affine_types=frozenset(),
+            )
+            sched.disarm()  # clean seeding; the storm hits the pump
+            seeded = []
+            for i in range(3):
+                branch = bundle.history.new_history_branch(
+                    tree_id=f"pump-run-{i}"
+                )
+                txn = 1
+                for b in prefix:
+                    bundle.history.append_history_nodes(
+                        branch, b, transaction_id=txn
+                    )
+                    txn += 1
+                t = engine.admit(
+                    "dom", f"pump-wf-{i}", f"pump-run-{i}",
+                    branch_token=branch.to_json().encode(),
+                    batches=prefix,
+                )
+                assert t is not None
+                seeded.append((i, branch, txn))
+            sched.arm()
+            txns = {i: txn for i, _, txn in seeded}
+            pump = TickPump(engine, 0.01, metrics=scope).start()
+            try:
+                # the write-heavy phase: durable chunk writes + one
+                # O(1) marker each, round-robin over the lanes — never
+                # a read, never an explicit tick
+                for c in range(CHUNKS):
+                    for i, branch, _ in seeded:
+                        for b in chunks[c]:
+                            bundle.history.append_history_nodes(
+                                branch, b, transaction_id=txns[i]
+                            )
+                            txns[i] += 1
+                        engine.on_persisted(
+                            "dom", f"pump-wf-{i}", f"pump-run-{i}",
+                            chunks[c][-1][-1].event_id + 1,
+                        )
+                        time.sleep(0.004)
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    with engine._lock:
+                        dirty = any(
+                            l is not None and (
+                                l.pending
+                                or l.behind_through > l.next_staged
+                            )
+                            for l in engine._slots
+                        )
+                    if not dirty:
+                        break
+                    time.sleep(0.01)
+                assert not dirty, "pump never composed the debt"
+            finally:
+                pump.stop()
+            assert sched.injected_total() > 0, "storm never fired"
+            stats = scope.registry.timer_stats("serving_staleness_ms")
+            assert stats.count >= 3
+            # the bound: pump cadence 10ms + fault-retry cycles, every
+            # compose executable pre-compiled — tight vs the unbounded
+            # pre-pump reality, with slack for a loaded CI host
+            assert stats.p99 < 750.0, (
+                f"staleness p99 {stats.p99:.1f}ms out of bound"
+            )
+            sched.disarm()
+            for i, branch, _ in seeded:
+                got = engine.read(f"pump-wf-{i}", f"pump-run-{i}")
+                assert got is not None and got.resident
+                pk = pack_lanes(
+                    [(f"pump-wf-{i}", f"pump-run-{i}", full_batches)],
+                    caps=caps,
+                )
+                want = S.state_row(replay_packed_lanes(pk), 0)
+                for k in S.STATE_ROW_FIELDS:
+                    np.testing.assert_array_equal(
+                        got.state_row[k], want[k],
+                        err_msg=f"pump wf {i} field {k}",
+                    )
+        finally:
+            bundle.close()
