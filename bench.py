@@ -1505,6 +1505,165 @@ def _bench_serve_overload(workflows: int, qps: float, lanes: int = 8,
     }
 
 
+def _bench_capacity_diurnal(workflows_per_chunk: int = 8,
+                            qps_low: float = 60.0,
+                            qps_high: float = 600.0,
+                            chunks_low: int = 3, chunks_high: int = 4,
+                            chunks_trough: int = 4, lanes: int = 16,
+                            min_events: int = 12, max_events: int = 24,
+                            initial_rps: float = 150.0):
+    """Capacity autopilot closed loop under a diurnal curve (ISSUE 16).
+
+    Offers a low -> high -> low open-loop stream against a live
+    limiter the ``CapacityController`` retunes between chunks — the
+    same sense (windowed serve_decision/serve_shed readings), decide
+    (EWMA'd offered-demand + hysteresis gate + guardrail), actuate
+    (``set_global_rate`` hook) loop the bootstrap wires. The record
+    pins the autopilot story: the admission setpoint tracks the curve
+    BOTH directions with zero operator calls and zero guardrail
+    freezes, while per-phase p99/shed stay explicit fields."""
+    import random as _random
+
+    from cadence_tpu.config.static import AutopilotConfig
+    from cadence_tpu.ops import schema as S
+    from cadence_tpu.runtime.autopilot import (
+        CapacityController,
+        KEY_HISTORY_RPS,
+    )
+    from cadence_tpu.serving import (
+        ArrivalProcess,
+        OpenLoopHarness,
+        ResidentEngine,
+        ServeWorkload,
+    )
+    from cadence_tpu.testing import workloads as W
+    from cadence_tpu.utils.metrics import NOOP as _NOOP, Scope, Window
+    from cadence_tpu.utils.quotas import (
+        MultiStageRateLimiter,
+        RetryBudget,
+    )
+
+    caps = S.Capacities(
+        max_events=512, max_activities=2, max_timers=2,
+        max_children=2, max_request_cancels=2, max_signals_ext=4,
+        max_version_items=2)
+
+    def make_chunk(rng, serial, tag):
+        loads = []
+        for _ in range(workflows_per_chunk):
+            serial[0] += 1
+            batches = W.signal_history(
+                rng, min_events=min_events, max_events=max_events)
+            cut = max(1, int(len(batches) * 0.4))
+            loads.append(ServeWorkload(
+                domain_id=f"dom-{serial[0] % 2}",
+                workflow_id=f"diurnal-{tag}-wf-{serial[0]}",
+                run_id=f"diurnal-{tag}-run-{serial[0]}",
+                branch_token=b"",
+                prefix=batches[:cut],
+                deltas=[batches[k:k + 2]
+                        for k in range(cut, len(batches), 2)],
+            ))
+        return loads
+
+    # jit warm round on its own engine/registry (serve_overload idiom)
+    warm_engine = ResidentEngine(lanes=lanes, caps=caps, metrics=_NOOP,
+                                 idle_ticks=2)
+    OpenLoopHarness(
+        warm_engine, make_chunk(_random.Random(41), [0], "warm"),
+        ArrivalProcess(qps=qps_low, seed=5), metrics=_NOOP,
+    ).run()
+    warm_engine.drain()
+
+    scope = Scope()
+    reg = scope.registry
+    engine = ResidentEngine(lanes=lanes, caps=caps, metrics=scope,
+                            idle_ticks=2)
+    limiter = MultiStageRateLimiter(
+        global_rps=initial_rps, domain_rps=lambda d: 1e9)
+    ap = CapacityController(
+        AutopilotConfig(
+            enabled=True, target_p99_ms=60_000.0, ewma_alpha=0.5,
+            min_dwell=1, cooldown_epochs=0, max_step_frac=0.5,
+            headroom_frac=0.5, min_rps=5.0),
+        registry=reg,
+        rate_hooks={KEY_HISTORY_RPS: limiter.set_global_rate},
+        initial_rates={KEY_HISTORY_RPS: limiter.global_rps},
+        metrics=scope,
+    )
+    rng = _random.Random(97)
+    serial = [0]
+    phase_window = Window(reg)
+
+    def run_phase(name, qps, chunks):
+        for _ in range(chunks):
+            OpenLoopHarness(
+                engine, make_chunk(rng, serial, name),
+                ArrivalProcess(qps=qps, seed=serial[0]),
+                metrics=scope, limiter=limiter,
+                retry_budget=RetryBudget(ratio=0.2, cap=16.0,
+                                         initial=8.0),
+            ).run()
+            ap.run_epoch_once()
+        r = phase_window.advance()
+        st = r.timer_stats("serve_decision")
+        shed = r.counter("serve_shed")
+        return {
+            "chunks": chunks,
+            "offered_qps_target": round(qps, 1),
+            "admitted": st.count,
+            "shed": shed,
+            "shed_frac": round(shed / max(shed + st.count, 1), 4),
+            "p99_ms": round(st.p99 * 1e3, 3),
+            "rate_rps": round(
+                ap.status()["rates"][KEY_HISTORY_RPS], 2),
+            "demand_rps": round(
+                r.gauge("autopilot_demand_rps"), 2),
+        }
+
+    try:
+        low = run_phase("low", qps_low, chunks_low)
+        high = run_phase("high", qps_high, chunks_high)
+        trough = run_phase("trough", qps_low, chunks_trough)
+    finally:
+        drained = engine.drain()
+
+    status = ap.status()
+    st = reg.timer_stats("serve_decision")
+    total_shed = reg.counter_value("serve_shed")
+    ap_tags = {"layer": "autopilot"}
+    operator_calls = (
+        reg.counter_value("autopilot_pauses", tags=ap_tags)
+        + reg.counter_value("autopilot_resumes", tags=ap_tags)
+    )
+    return {
+        "workflows_per_chunk": workflows_per_chunk,
+        "lanes": lanes,
+        "qps_low": round(qps_low, 1),
+        "qps_high": round(qps_high, 1),
+        "initial_rps": round(initial_rps, 1),
+        "phases": {"low": low, "high": high, "trough": trough},
+        "rate_low_rps": low["rate_rps"],
+        "rate_high_rps": high["rate_rps"],
+        "rate_final_rps": trough["rate_rps"],
+        "rate_tracks_load": bool(
+            high["rate_rps"] > low["rate_rps"] * 1.2
+            and trough["rate_rps"] < high["rate_rps"]
+        ),
+        "epochs": status["epochs_run"],
+        "retunes": reg.counter_value(
+            "autopilot_rate_retunes", tags=ap_tags),
+        "guardrail_freezes": status["guardrail_freezes"],
+        "gate_switches": status["gate_switches"],
+        "overloaded_final": status["overloaded"],
+        "operator_calls": operator_calls,
+        "p99_overall_ms": round(st.p99 * 1e3, 3),
+        "shed_frac_overall": round(
+            total_shed / max(total_shed + st.count, 1), 4),
+        "drain_flush_failed": drained["flush_failed"],
+    }
+
+
 def _bench_telemetry_overhead(calls: int = 30000, rounds: int = 5):
     """Unsampled telemetry cost on the instrumented serving path.
 
@@ -2093,6 +2252,13 @@ def main() -> None:
         # (ISSUE 15; README "Overload control")
         "serve_overload": dict(overload=dict(
             workflows=24, qps=400.0, lanes=8, capacity_frac=0.5)),
+        # closed-loop capacity autopilot under a diurnal load curve:
+        # the admission setpoint must track offered load BOTH ways
+        # with zero operator calls and zero guardrail freezes
+        # (ISSUE 16; README "Capacity autopilot")
+        "capacity_diurnal": dict(diurnal=dict(
+            workflows_per_chunk=8, qps_low=60.0, qps_high=600.0,
+            chunks_low=3, chunks_high=4, chunks_trough=4, lanes=16)),
         # unsampled telemetry cost on the instrumented serving path:
         # the ≤3% guard tests/test_bench_smoke.py pins (utils/tracing)
         "telemetry_overhead": dict(telemetry=dict(
@@ -2142,6 +2308,17 @@ def main() -> None:
             "serve_overload": dict(overload=dict(
                 workflows=9, qps=150.0, lanes=4, capacity_frac=0.5,
                 min_events=16, max_events=32)),
+            # capacity-autopilot JSON contract at seconds scale: the
+            # setpoint tracks low->high->low, zero guardrail freezes,
+            # zero operator calls
+            # (4 trough chunks: the demand EWMA needs the extra epoch
+            # to decay visibly below the peak on a slow/contended CPU,
+            # where compute bounds the offered rate and compresses the
+            # low-vs-high dynamic range)
+            "capacity_diurnal": dict(diurnal=dict(
+                workflows_per_chunk=4, qps_low=30.0, qps_high=300.0,
+                chunks_low=2, chunks_high=3, chunks_trough=4, lanes=8,
+                min_events=10, max_events=16, initial_rps=100.0)),
             # the ≤3% unsampled-tracing guard at smoke scale. The
             # min-over-paired-rounds estimator needs ONE clean pair;
             # shorter rounds shrink the per-pair window a host stall
@@ -2210,6 +2387,15 @@ def main() -> None:
         elif "overload" in cfg:
             try:
                 results[config] = _bench_serve_overload(**cfg["overload"])
+            except Exception as e:
+                results[config] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"
+                }
+        elif "diurnal" in cfg:
+            try:
+                results[config] = _bench_capacity_diurnal(
+                    **cfg["diurnal"]
+                )
             except Exception as e:
                 results[config] = {
                     "error": f"{type(e).__name__}: {str(e)[:200]}"

@@ -46,6 +46,13 @@ class RunningServer:
     # serving.ResidentEngine when the serving section is enabled
     # (history hosts only); drained by HistoryService.stop()
     serving: object = None
+    # runtime.autopilot.CapacityController when the autopilot section
+    # is enabled (history hosts only); stopped by HistoryService.stop()
+    autopilot: object = None
+    # the programmatic dynamicconfig override layer (InMemoryClient)
+    # the autopilot writes rates through; always built so tests and
+    # operators can inject overrides live even with autopilot off
+    dyncfg_overrides: object = None
 
     @property
     def addresses(self) -> Dict[str, str]:
@@ -164,14 +171,23 @@ def start_services(
     domains = DomainCache(persistence.metadata)
     cluster_metadata = cfg.build_cluster_metadata()
 
-    # dynamic config: file-watched when configured, in-memory otherwise
-    # (ref cmd/server wiring of dynamicconfig fileBasedClient)
-    from cadence_tpu.utils.dynamicconfig import Collection, FileBasedClient
-
-    dyncfg = Collection(
-        FileBasedClient(cfg.dynamicconfig_path)
-        if cfg.dynamicconfig_path else None
+    # dynamic config: a programmatic override layer (the autopilot's
+    # rate actuator — and the operator's live-injection surface) over
+    # the file-watched base when configured (ref cmd/server wiring of
+    # dynamicconfig fileBasedClient)
+    from cadence_tpu.utils.dynamicconfig import (
+        Collection,
+        FileBasedClient,
+        InMemoryClient,
+        LayeredClient,
     )
+
+    dyncfg_overrides = InMemoryClient()
+    dyncfg = Collection(LayeredClient(
+        dyncfg_overrides,
+        FileBasedClient(cfg.dynamicconfig_path)
+        if cfg.dynamicconfig_path else None,
+    ))
 
     # the host's ring identity per service is its rpc bind address;
     # bootstrap hosts from config pre-populate the rings so a partial
@@ -214,6 +230,7 @@ def start_services(
         metrics=metrics,
         checkpoints=checkpoints,
         serving=serving,
+        dyncfg_overrides=dyncfg_overrides,
     )
     # one diagnostics endpoint per process (common/pprof.go Start):
     # first configured service's port wins, bound on that service's
@@ -284,6 +301,57 @@ def start_services(
         # (consumed by enable_replication_from / _build_shard)
         history.replication_config = cfg.replication
         out.history = history
+
+        # capacity autopilot (config `autopilot:` section): closed-loop
+        # retuning of the limiters above + reshard proposals through
+        # the host's shared coordinator. Built BEFORE history.start()
+        # (which starts its epoch loop); only the membership-elected
+        # host actuates, so every history host wires one identically
+        if cfg.autopilot.enabled:
+            from cadence_tpu.runtime.autopilot import (
+                KEY_HISTORY_DOMAIN_RPS,
+                KEY_HISTORY_RPS,
+                KEY_MATCHING_RPS,
+                KEY_SERVING_QUOTA_RPS,
+                CapacityController,
+            )
+
+            rate_hooks = {
+                KEY_HISTORY_RPS: history_limiter.set_global_rate,
+                KEY_MATCHING_RPS: matching_limiter.set_global_rate,
+            }
+            initial_rates = {
+                KEY_HISTORY_RPS: history_limiter.global_rps,
+                KEY_MATCHING_RPS: matching_limiter.global_rps,
+                # domain rps needs no hook: the limiters re-read the
+                # dynamicconfig property per call, and the override
+                # layer shadows the file live
+                KEY_HISTORY_DOMAIN_RPS: history_domain_rps(),
+            }
+            if serving is not None and serving.admission_quota_rps() > 0:
+                # an unmetered quota (0) stays unmetered: minting a
+                # finite cap where the operator set none is a semantic
+                # change, not a retune
+                rate_hooks[KEY_SERVING_QUOTA_RPS] = (
+                    serving.retune_admission
+                )
+                initial_rates[KEY_SERVING_QUOTA_RPS] = (
+                    serving.admission_quota_rps()
+                )
+            out.autopilot = history.autopilot = CapacityController(
+                cfg.autopilot,
+                registry=metrics.registry,
+                overrides=dyncfg_overrides,
+                rate_hooks=rate_hooks,
+                initial_rates=initial_rates,
+                resharder=(
+                    history.reshard_coordinator
+                    if cfg.resharding.enabled else None
+                ),
+                history=history,
+                monitor=monitor,
+                metrics=metrics,
+            )
 
     hc = RoutedHistoryClient(
         monitor,

@@ -21,39 +21,18 @@ class AdminHandler:
         # message bus for DLQ operator verbs (None on hosts that don't
         # run the messaging plane)
         self.bus = bus
-        self._resharder = None
-        import threading
-
-        self._resharder_lock = threading.Lock()
 
     # -- elastic resharding (runtime/resharding.py) --------------------
 
     @property
     def resharder(self):
-        """Lazily-built reshard coordinator over this host's controller
-        (multi-host in-process clusters build their own coordinator
-        spanning every controller). Built under a lock: two racing
-        admin verbs must share ONE coordinator — its internal lock is
-        what serializes reconfigurations in-process."""
-        with self._resharder_lock:
-            if self._resharder is None:
-                from cadence_tpu.runtime.resharding import (
-                    ReshardCoordinator,
-                )
-
-                cfg = getattr(self.history, "resharding_config", None)
-                self._resharder = ReshardCoordinator(
-                    self.history.persistence,
-                    [self.history.controller],
-                    metrics=self.history.metrics,
-                    drain_timeout_s=(
-                        cfg.drain_timeout_s if cfg is not None else 10.0
-                    ),
-                    checkpoint_flush=(
-                        cfg.checkpoint_flush if cfg is not None else True
-                    ),
-                )
-            return self._resharder
+        """The host's shared reshard coordinator — built and owned by
+        ``HistoryService.reshard_coordinator()`` so the admin verbs and
+        the capacity autopilot serialize plans on the SAME coordinator
+        lock (one plan at a time is a host property, not a caller
+        property). Multi-host in-process clusters build their own
+        coordinator spanning every controller."""
+        return self.history.reshard_coordinator()
 
     def reshard_split(self, shard_id: int) -> Dict[str, Any]:
         """Online shard split 1→2 (admin verb; returns the committed
@@ -74,6 +53,33 @@ class AdminHandler:
         cfg = getattr(self.history, "resharding_config", None)
         if cfg is not None and not cfg.enabled:
             raise BadRequestError("resharding is disabled by config")
+
+    # -- capacity autopilot (runtime/autopilot.py) ---------------------
+
+    def _require_autopilot(self):
+        ap = getattr(self.history, "autopilot", None)
+        if ap is None:
+            raise BadRequestError(
+                "capacity autopilot is not enabled on this host"
+            )
+        return ap
+
+    def autopilot_status(self) -> Dict[str, Any]:
+        """The controller's full decision state: setpoints, EWMAs, gate
+        + freeze + pause flags, cooldowns, last sensed reading."""
+        return self._require_autopilot().status()
+
+    def autopilot_pause(self, reason: str = "") -> Dict[str, Any]:
+        """Operator override: stop actuating (sensing continues) until
+        ``autopilot_resume``. The last word stays with the human."""
+        ap = self._require_autopilot()
+        ap.pause(reason or "admin verb")
+        return ap.status()
+
+    def autopilot_resume(self) -> Dict[str, Any]:
+        ap = self._require_autopilot()
+        ap.resume()
+        return ap.status()
 
     def describe_queue_states(self, shard_id: int) -> Dict[str, Any]:
         """Per-queue cursor/depth introspection for one owned shard

@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from cadence_tpu.utils.backoff import BackoffLadder
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.task_processor import KeyedSequentialProcessor
 
@@ -596,12 +597,16 @@ class ReplicationTaskProcessor:
             # dead remote link costs one retry per backoff_max_s (not a
             # log line every interval_s), and the first successful
             # cycle resets the ladder so a healed link resumes at full
-            # pull cadence immediately
-            backoff_s = interval_s
+            # pull cadence immediately. Jitter keeps concurrent shards
+            # pulling one dead link from retrying in phase.
+            ladder = BackoffLadder(
+                interval_s, max(self.backoff_max_s, interval_s),
+                jitter=0.5, rng=self._backoff_rng,
+            )
             while not self._stop.is_set():
                 try:
                     n = self.process_once()
-                    backoff_s = interval_s
+                    ladder.success()
                     if n == 0:
                         self._stop.wait(interval_s)
                 except Exception:
@@ -611,12 +616,7 @@ class ReplicationTaskProcessor:
                         cluster=self.fetcher.cluster,
                     )
                     self._metrics.inc("replication_pump_backoffs")
-                    # full jitter in [backoff/2, backoff): concurrent
-                    # shards pulling one dead link don't retry in phase
-                    self._stop.wait(
-                        backoff_s * (0.5 + 0.5 * self._backoff_rng.random())
-                    )
-                    backoff_s = min(backoff_s * 2, self.backoff_max_s)
+                    self._stop.wait(ladder.failure())
 
         self._thread = threading.Thread(target=pump, daemon=True)
         self._thread.start()

@@ -8,6 +8,7 @@ pushes, and a history client for cross-shard workflow calls.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 from cadence_tpu.utils.clock import TimeSource
@@ -93,6 +94,14 @@ class HistoryService:
         # config.ReshardingConfig (`resharding:` section) — read by the
         # admin reshard verbs; None = defaults (enabled)
         self.resharding_config = None
+        # the ONE ReshardCoordinator per host: the admin verbs and the
+        # capacity autopilot share it (two coordinators would each hold
+        # their own lock — "one plan at a time" must be host-wide)
+        self._resharder = None
+        self._resharder_lock = threading.Lock()
+        # runtime.autopilot.CapacityController (config `autopilot:`
+        # section), attached by bootstrap/Onebox; None = manual capacity
+        self.autopilot = None
         self._log = get_logger(
             "cadence_tpu.history.service", host=monitor.self_identity
         )
@@ -142,8 +151,14 @@ class HistoryService:
                 self.serving, self.serving.tick_interval_s,
                 metrics=self.metrics,
             ).start()
+        if self.autopilot is not None:
+            self.autopilot.start()
 
     def stop(self) -> None:
+        if self.autopilot is not None:
+            # the controller goes FIRST: a retune or reshard proposal
+            # racing the drain below would act on shards mid-teardown
+            self.autopilot.stop()
         if self._tick_pump is not None:
             # pump drain-on-stop FIRST: its final tick composes Δs
             # staged since the last cycle, so the lane flush below
@@ -364,6 +379,34 @@ class HistoryService:
         return self.serving.read_through(
             domain_id, workflow_id, run_id, branch_token
         )
+
+    # -- resharding ----------------------------------------------------
+
+    def reshard_coordinator(self):
+        """The host's ONE ReshardCoordinator, built lazily: the admin
+        verbs and the capacity autopilot both call through here, so
+        their plans serialize on the same coordinator lock — one plan
+        at a time is a host property, not a caller property."""
+        with self._resharder_lock:
+            if self._resharder is None:
+                from cadence_tpu.runtime.resharding import (
+                    ReshardCoordinator,
+                )
+
+                cfg = self.resharding_config
+                self._resharder = ReshardCoordinator(
+                    self.persistence,
+                    [self.controller],
+                    metrics=self.metrics,
+                    drain_timeout_s=(
+                        cfg.drain_timeout_s if cfg is not None else 10.0
+                    ),
+                    checkpoint_flush=(
+                        cfg.checkpoint_flush if cfg is not None else True
+                    ),
+                    time_source=self._time,
+                )
+            return self._resharder
 
     # -- introspection -------------------------------------------------
 

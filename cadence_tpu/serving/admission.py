@@ -205,6 +205,22 @@ class FairAdmissionQueue:
                 break  # every remaining bid is quota-parked this round
         return out
 
+    def set_quota_rps(
+        self, rps: float, burst: Optional[int] = None
+    ) -> None:
+        """Caller holds the guard. Live retune of the per-domain refill
+        quota (the autopilot's serving actuator): updates the policy so
+        future buckets mint at the new rate, and ``set_rate``s every
+        existing bucket so retuning takes effect this recycle, not at
+        the next domain-table miss."""
+        if rps < 0:
+            raise ValueError("admission: negative quota")
+        self.policy.quota_rps = float(rps)
+        if burst is not None:
+            self.policy.quota_burst = int(burst)
+        for bucket in self._quota.values():
+            bucket.set_rate(rps, burst=burst)
+
     def _quota_bucket(self, dom: str) -> TokenBucket:
         bucket = self._quota.get(dom)
         if bucket is None:

@@ -1173,6 +1173,18 @@ class ResidentEngine:
             "queued_dropped": queued,
         }
 
+    def retune_admission(
+        self, quota_rps: float, quota_burst=None
+    ) -> None:
+        """Live retune of the admission queue's per-domain quota (the
+        capacity autopilot's serving-plane actuator)."""
+        with self._lock:
+            self._admit_queue.set_quota_rps(quota_rps, burst=quota_burst)
+
+    def admission_quota_rps(self) -> float:
+        with self._lock:
+            return self._admit_queue.policy.quota_rps
+
     def occupancy(self) -> float:
         with self._lock:
             seated = sum(

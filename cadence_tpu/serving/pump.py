@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import threading
 
+from cadence_tpu.utils.backoff import BackoffLadder
 from cadence_tpu.utils.log import get_logger
 from cadence_tpu.utils.metrics import NOOP, Scope
 
@@ -66,11 +67,13 @@ class TickPump:
         return self
 
     def _run(self) -> None:
+        ladder = BackoffLadder(self.interval_s, self.interval_s * 8.0)
         delay = self.interval_s
         while not self._stop.wait(delay):
             try:
                 self.engine.tick()
                 self.cycles += 1
+                ladder.success()
                 delay = self.interval_s
             except Exception as e:
                 # a sick store must not kill the staleness bound for
@@ -78,7 +81,7 @@ class TickPump:
                 self.errors += 1
                 self._metrics.inc("serving_tick_pump_errors")
                 self._log.warn(f"tick pump cycle failed ({e}); backoff")
-                delay = min(delay * 2.0, self.interval_s * 8.0)
+                delay = ladder.failure()
 
     def stop(self, timeout_s: float = 5.0) -> None:
         """Drain-on-stop: join the pump, then one final tick composes

@@ -44,6 +44,7 @@ class Onebox:
         checkpoints=None,
         serving=None,
         sanitize: bool = False,
+        autopilot=None,
     ) -> None:
         self.faults = faults
         self.persistence = persistence or create_memory_bundle()
@@ -130,6 +131,44 @@ class Onebox:
             metrics=self.metrics,
         )
         self.admin = AdminHandler(self.history, self.domains, bus=self.bus)
+        # autopilot: True builds an in-process CapacityController over
+        # this box's registry + shared reshard coordinator (epoch loop
+        # starts/stops with the history service); or pass an
+        # AutopilotConfig for custom knobs; None/False = manual capacity
+        self.autopilot = None
+        if autopilot:
+            from cadence_tpu.config.static import AutopilotConfig
+            from cadence_tpu.runtime.autopilot import CapacityController
+
+            ap_cfg = (
+                autopilot if isinstance(autopilot, AutopilotConfig)
+                else AutopilotConfig(enabled=True)
+            )
+            rate_hooks = {}
+            initial_rates = {}
+            if (self.serving is not None
+                    and self.serving.admission_quota_rps() > 0):
+                from cadence_tpu.runtime.autopilot import (
+                    KEY_SERVING_QUOTA_RPS,
+                )
+
+                rate_hooks[KEY_SERVING_QUOTA_RPS] = (
+                    self.serving.retune_admission
+                )
+                initial_rates[KEY_SERVING_QUOTA_RPS] = (
+                    self.serving.admission_quota_rps()
+                )
+            self.autopilot = self.history.autopilot = CapacityController(
+                ap_cfg,
+                registry=self.metrics.registry,
+                overrides=None,
+                rate_hooks=rate_hooks,
+                initial_rates=initial_rates,
+                resharder=self.history.reshard_coordinator,
+                history=self.history,
+                monitor=self.monitor,
+                metrics=self.metrics,
+            )
         self.worker: Optional[WorkerService] = None
         self._start_worker = start_worker
         self._started = False

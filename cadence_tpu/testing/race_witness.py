@@ -90,6 +90,11 @@ GUARDED_FIELDS: Dict[str, str] = {
     "ResidentEngine._slots": "ResidentEngine._lock",
     "ResidentEngine._by_key": "ResidentEngine._lock",
     "FairAdmissionQueue._parked": "ResidentEngine._lock",
+    # capacity autopilot (runtime/autopilot.py): the rate setpoints and
+    # the per-actuator cooldown table are written by the epoch thread
+    # and read by the admin status/pause verbs
+    "CapacityController._rates": "CapacityController._lock",
+    "CapacityController._cooldowns": "CapacityController._lock",
 }
 
 

@@ -156,6 +156,62 @@ class ExponentialRetryPolicy:
         return d
 
 
+class BackoffLadder:
+    """Error-backoff ladder for pump loops (one shared implementation
+    for the replication pump, the serving tick pump, and the autopilot
+    epoch loop — they each grew their own copy before this).
+
+    Contract:
+
+    * ``failure()`` returns the delay to sleep after a FAILED cycle —
+      the current rung, jittered down by up to ``jitter`` — and doubles
+      the rung, capped at ``cap_s``;
+    * ``success()`` resets the ladder to ``base_s`` so a healed
+      dependency resumes at full cadence immediately;
+    * jitter is multiplicative-down (``d * (1 - jitter * rng())``) so
+      concurrent loops sharing one dead dependency don't retry in
+      phase, and the returned delay never exceeds the cap.
+    """
+
+    def __init__(
+        self,
+        base_s: float,
+        cap_s: float,
+        jitter: float = 0.0,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        if base_s <= 0:
+            raise ValueError("backoff ladder: base_s must be > 0")
+        if cap_s < base_s:
+            raise ValueError("backoff ladder: cap_s must be >= base_s")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("backoff ladder: jitter must be in [0, 1)")
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = float(jitter)
+        self._rng = rng if rng is not None else random.Random()
+        self._delay = self.base_s
+        self.failures = 0
+
+    @property
+    def current_s(self) -> float:
+        """The rung the next ``failure()`` will sleep (unjittered)."""
+        return self._delay
+
+    def failure(self) -> float:
+        """Record a failed cycle; return the (jittered) sleep delay."""
+        self.failures += 1
+        d = self._delay
+        self._delay = min(self._delay * 2.0, self.cap_s)
+        if self.jitter:
+            d *= 1.0 - self.jitter * self._rng.random()
+        return d
+
+    def success(self) -> None:
+        """Reset: the next failure starts back at ``base_s``."""
+        self._delay = self.base_s
+
+
 T = TypeVar("T")
 
 

@@ -32,7 +32,8 @@ class Client:
 
 
 class InMemoryClient(Client):
-    """Programmatic overrides — the test fixture."""
+    """Programmatic overrides — the test fixture and the autopilot's
+    override plane."""
 
     def __init__(self) -> None:
         self._values: Dict[str, List[Tuple[Dict[str, Any], Any]]] = {}
@@ -41,8 +42,36 @@ class InMemoryClient(Client):
     def set_value(
         self, key: str, value: Any, filters: Optional[Dict[str, Any]] = None
     ) -> None:
+        """Set an override; an entry with EQUAL filters is replaced in
+        place, so a controller retuning the same key every epoch stays
+        O(1) per key instead of growing the entry list unboundedly (and
+        `_best_match` never sees the stale value)."""
+        fdict = dict(filters or {})
         with self._lock:
-            self._values.setdefault(key, []).append((dict(filters or {}), value))
+            entries = self._values.setdefault(key, [])
+            for i, (entry_filters, _) in enumerate(entries):
+                if entry_filters == fdict:
+                    entries[i] = (fdict, value)
+                    return
+            entries.append((fdict, value))
+
+    def remove_value(
+        self, key: str, filters: Optional[Dict[str, Any]] = None
+    ) -> bool:
+        """Remove the override with EXACTLY these filters (None/{} means
+        the unfiltered entry). Returns True if an entry was removed."""
+        fdict = dict(filters or {})
+        with self._lock:
+            entries = self._values.get(key)
+            if not entries:
+                return False
+            for i, (entry_filters, _) in enumerate(entries):
+                if entry_filters == fdict:
+                    del entries[i]
+                    if not entries:
+                        del self._values[key]
+                    return True
+        return False
 
     def get_value(self, key: str, filters: Dict[str, Any]) -> Optional[Any]:
         with self._lock:
@@ -103,6 +132,31 @@ class FileBasedClient(Client):
         with self._lock:
             entries = list(self._values.get(key, ()))
         return _best_match(entries, filters)
+
+
+class LayeredClient(Client):
+    """Programmatic overrides layered over a base client.
+
+    The capacity autopilot (and tests) write through ``overrides`` —
+    an :class:`InMemoryClient` — while operator-managed values keep
+    coming from the base (file) client. An override, when present for
+    the key+filters, ALWAYS wins over the base; ``remove_value`` on the
+    override layer falls back to the base value, which is the
+    autopilot's revert-to-operator-config path."""
+
+    def __init__(
+        self, overrides: InMemoryClient, base: Optional[Client] = None
+    ) -> None:
+        self.overrides = overrides
+        self.base = base
+
+    def get_value(self, key: str, filters: Dict[str, Any]) -> Optional[Any]:
+        v = self.overrides.get_value(key, filters)
+        if v is not None:
+            return v
+        if self.base is not None:
+            return self.base.get_value(key, filters)
+        return None
 
 
 def _best_match(

@@ -338,6 +338,52 @@ TELEMETRY_METRICS = (
     "metrics_dropped_series",
 )
 
+# capacity autopilot (runtime/autopilot.py), tagged (layer=autopilot).
+# The epoch loop: autopilot_epochs/autopilot_epoch_seconds count and
+# time every sense→decide→actuate pass; autopilot_skipped_epochs are
+# passes that sensed but did not actuate (paused / not the elected
+# actuator / frozen); autopilot_errors are passes that raised (the loop
+# backs off and keeps going). Sensing: autopilot_sensed_p99_ms /
+# autopilot_sensed_shed_frac are the raw interval readings,
+# autopilot_demand_rps the smoothed OFFERED rate (admitted + shed —
+# shed traffic is demand) the rate plane tracks, autopilot_pressure
+# the EWMA'd p99/target (escalated by shed/target only once latency
+# is at target — shed alone must not spiral the gate) the gate sees,
+# autopilot_overload_engaged the gate state (1 = overloaded).
+# Rate plane: autopilot_rate_retunes counts setpoint changes,
+# autopilot_rate_rps (key=...) gauges each current setpoint,
+# autopilot_cooldown_skips counts actuations suppressed by a cooldown
+# or reshard backoff. Topology plane: autopilot_reshard_plans counts
+# committed split/merge plans, autopilot_reshard_failures aborted ones
+# (each engages the proposal backoff — never a hot retry). Guardrail:
+# autopilot_guardrail_freezes counts do-no-harm trips,
+# autopilot_reverts the rates restored to last-known-good,
+# autopilot_frozen the freeze state gauge. Operator plane:
+# autopilot_pauses/autopilot_resumes count the admin verbs,
+# autopilot_paused gauges the current pause state.
+AUTOPILOT_METRICS = (
+    "autopilot_epochs",
+    "autopilot_epoch_seconds",
+    "autopilot_skipped_epochs",
+    "autopilot_errors",
+    "autopilot_sensed_p99_ms",
+    "autopilot_sensed_shed_frac",
+    "autopilot_demand_rps",
+    "autopilot_pressure",
+    "autopilot_overload_engaged",
+    "autopilot_rate_retunes",
+    "autopilot_rate_rps",
+    "autopilot_cooldown_skips",
+    "autopilot_reshard_plans",
+    "autopilot_reshard_failures",
+    "autopilot_guardrail_freezes",
+    "autopilot_reverts",
+    "autopilot_frozen",
+    "autopilot_pauses",
+    "autopilot_resumes",
+    "autopilot_paused",
+)
+
 # the standard per-operation triple
 REQUESTS = "requests"
 LATENCY = "latency"

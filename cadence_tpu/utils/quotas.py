@@ -121,6 +121,18 @@ class MultiStageRateLimiter:
                     bucket.set_rate(rps)
         return bucket
 
+    def set_global_rate(self, rps: float) -> None:
+        """Live retune of the GLOBAL stage (the autopilot's history/
+        matching rps actuator). Per-domain stages already follow their
+        ``domain_rps`` callable per call; the global bucket is sized
+        once at construction, so a closed-loop controller needs this
+        explicit hook."""
+        self._global.set_rate(rps)
+
+    @property
+    def global_rps(self) -> float:
+        return self._global.rps
+
     def allow(self, domain: str = "") -> bool:
         # DOMAIN bucket first (reference multiStageRateLimiter): a
         # throttled domain must not drain the global budget and starve

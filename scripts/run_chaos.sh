@@ -48,6 +48,16 @@
 #       # bounded, and the tick pump holds serving_staleness_ms p99
 #       # under the configured bound
 #
+#   CHAOS_AUTOPILOT=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # capacity-autopilot sweep (TestAutopilotChaos): the closed
+#       # sense->decide->actuate loop under chaos — a diurnal sweep
+#       # where the admission setpoint tracks offered load both ways
+#       # with zero operator calls, a real shard split actuated
+#       # through the shared coordinator under the >=10% write-fault
+#       # storm with byte-identical replay, and a failed reshard plan
+#       # rolling back onto the controller's backoff ladder (never a
+#       # hot retry)
+#
 # Extra pytest args pass through: scripts/run_chaos.sh -k differential
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -73,6 +83,9 @@ fi
 if [[ -n "${CHAOS_OVERLOAD:-}" ]]; then
     FILTER=(-k TestOverloadChaos)
 fi
+if [[ -n "${CHAOS_AUTOPILOT:-}" ]]; then
+    FILTER=(-k TestAutopilotChaos)
+fi
 
 run_one() {
     local seed="$1"; shift
@@ -81,6 +94,7 @@ run_one() {
     # slow-marked members tier-1 leaves out for wall-clock budget
     CHAOS_SEED="${seed}" python -m pytest tests/test_chaos_recovery.py \
         tests/test_failover_drills.py \
+        tests/test_autopilot.py \
         -q -m chaos --runslow -p no:cacheprovider \
         ${FILTER[@]+"${FILTER[@]}"} "$@"
 }

@@ -204,6 +204,29 @@ def test_smoke_emits_one_json_record():
     assert ovl["offered"] == ovl["requests"] + ovl["retries"], ovl
     assert ovl["staleness_in_bound"] is True, ovl
     assert ovl["drain_flush_failed"] == 0, ovl
+    # the capacity-autopilot contract (ISSUE 16): over a low->high->low
+    # diurnal curve the closed loop retunes the live admission setpoint
+    # to track offered demand BOTH directions — hands off (zero
+    # operator verbs), do-no-harm (zero guardrail freezes), and every
+    # phase reports its own p99/shed/rate/demand fields
+    dr = out["configs"]["capacity_diurnal"]
+    for key in ("phases", "rate_low_rps", "rate_high_rps",
+                "rate_final_rps", "rate_tracks_load", "retunes",
+                "guardrail_freezes", "gate_switches", "operator_calls",
+                "epochs", "p99_overall_ms", "shed_frac_overall",
+                "drain_flush_failed"):
+        assert key in dr, f"capacity_diurnal lacks {key}"
+    for phase in ("low", "high", "trough"):
+        rec = dr["phases"][phase]
+        for key in ("offered_qps_target", "admitted", "shed_frac",
+                    "p99_ms", "rate_rps", "demand_rps"):
+            assert key in rec, f"capacity_diurnal.{phase} lacks {key}"
+        assert rec["admitted"] > 0, (phase, rec)
+    assert dr["rate_tracks_load"] is True, dr
+    assert dr["retunes"] >= 3, dr
+    assert dr["guardrail_freezes"] == 0, dr
+    assert dr["operator_calls"] == 0, dr
+    assert dr["drain_flush_failed"] == 0, dr
 
 
 def test_watchdog_still_yields_parseable_record():
@@ -254,6 +277,13 @@ def test_serve_continuous_degrades_to_cpu_fallback_record():
         rec["completed"] > 0 for rec in ovl["per_domain"].values()
     ), ovl
     assert ovl["staleness_in_bound"] is True, ovl
+    # the autopilot config's CPU-fallback degrade pin: the closed loop
+    # still runs and tracks on the fallback backend — never a crash,
+    # never a missing or freeze-tainted record
+    dr = out["configs"]["capacity_diurnal"]
+    assert dr["rate_tracks_load"] is True, dr
+    assert dr["guardrail_freezes"] == 0, dr
+    assert dr["operator_calls"] == 0, dr
 
 
 @pytest.mark.slow

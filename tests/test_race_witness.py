@@ -443,9 +443,16 @@ def _drive_sanitized_box(num_workflows=2):
     try:
         # serving=True: the resident engine's guarded lane table +
         # admission queue must instantiate (and its lock edges be
-        # observed) under the same acceptance drive
+        # observed) under the same acceptance drive; the autopilot so
+        # the capacity controller's guarded setpoint/cooldown tables
+        # register too (epoch interval parked way out so the drive's
+        # shard topology stays deterministic — registration is what
+        # the guarded-field assertion needs)
+        from cadence_tpu.config.static import AutopilotConfig
+
         box = Onebox(
-            num_shards=2, sanitize=True, checkpoints=True, serving=True
+            num_shards=2, sanitize=True, checkpoints=True, serving=True,
+            autopilot=AutopilotConfig(enabled=True, epoch_interval_s=3600),
         ).start()
         try:
             box.domain_handler.register_domain("san-dom")
@@ -578,11 +585,12 @@ class TestLockGraphArtifact:
         assert all(
             e["status"] == "unknown" for e in doc["baseline_entries"]
         )
-        # the static inventory covers the newly scoped serving edge
+        # the static inventory covers the host resharder lock (moved
+        # to HistoryService so the autopilot shares the coordinator)
         lock_ids = {l["id"] for l in loaded["locks"]}
         assert (
-            "cadence_tpu/frontend/admin_handler.py:"
-            "AdminHandler._resharder_lock" in lock_ids
+            "cadence_tpu/runtime/service.py:"
+            "HistoryService._resharder_lock" in lock_ids
         )
         assert any("client/routed.py" in l for l in lock_ids)
 

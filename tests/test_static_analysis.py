@@ -1352,15 +1352,18 @@ class TestCleanTreeGate:
         by_pass = run_all(REPO_ROOT)
         elapsed = time.process_time() - t0
         # the CI budget: all five passes trace + scan well under a
-        # minute; ~1.7 s CPU standalone. The bound is 10 s because the
+        # minute; ~2 s CPU standalone. The bound is 20 s because the
         # guarded failure mode is a RUNAWAY pass (accidental
         # quadratic closure, tracing the kernel per event type), not
         # percent drift: late in a full suite run the surface/jit
         # jaxpr tracing pays 3-4 s extra CPU against the
-        # suite-polluted JAX caches, and the old 5 s bound flaked on
-        # exactly that (seen at 5.1 s on an unmodified tree)
-        assert elapsed < 10.0, (
-            f"analysis gate took {elapsed:.1f}s CPU (budget 10s)"
+        # suite-polluted JAX caches — the old 5 s bound flaked at
+        # 5.1 s on an unmodified tree, and 10 s flaked at 11.1 s once
+        # the tree grew the autopilot subsystem (~2.7k more lines for
+        # the passes to scan). A runaway pass blows through 20 s by an
+        # order of magnitude, so the guard keeps its teeth
+        assert elapsed < 20.0, (
+            f"analysis gate took {elapsed:.1f}s CPU (budget 20s)"
         )
         all_findings = dedupe(
             [f for fs in by_pass.values() for f in fs]
@@ -1520,15 +1523,16 @@ class TestLockGraphStatic:
 
     def test_scope_covers_serving_edge(self):
         """Satellite: frontend/, client/ and rpc/ are scanned — the
-        admin handler's resharder lock and the routed client's stub
-        cache are in the inventory."""
+        host resharder lock (moved from the admin handler to
+        HistoryService so the autopilot shares the coordinator) and
+        the routed client's stub cache are in the inventory."""
         for scope in ("cadence_tpu/frontend", "cadence_tpu/client",
                       "cadence_tpu/rpc"):
             assert scope in lock_order.SCOPE_DIRS
         graph = lock_order.build_graph(REPO_ROOT)
         assert (
-            "cadence_tpu/frontend/admin_handler.py:"
-            "AdminHandler._resharder_lock" in graph.locks
+            "cadence_tpu/runtime/service.py:"
+            "HistoryService._resharder_lock" in graph.locks
         )
         assert (
             "cadence_tpu/client/routed.py:_StubCache._lock"
