@@ -281,6 +281,12 @@ def start_services(
 
     history = None
     if "history" in services:
+        # parallel queue execution (config `queues:` section): one
+        # shared conflict-keyed wave executor per host, or None when
+        # queues.parallelism is 0 (sequential per-queue pumps). A stale
+        # matrix artifact degrades the executor loudly to sequential —
+        # it never blocks boot.
+        queue_executor = cfg.queues.build_executor(metrics=metrics)
         history = HistoryService(
             cfg.persistence.num_history_shards, persistence, domains,
             monitor, cluster_metadata=cluster_metadata,
@@ -294,6 +300,7 @@ def start_services(
             checkpoints=checkpoints,
             serving=serving,
             rate_limiter=history_limiter,
+            queue_executor=queue_executor,
         )
         # admin reshard verbs read the section off the service
         history.resharding_config = cfg.resharding

@@ -133,6 +133,51 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass
+class QueuesConfig:
+    """Parallel queue execution (runtime/queues/parallel.py).
+
+    ``parallelism`` > 0 replaces the per-queue sequential pump threads
+    with one shared ParallelQueueExecutor draining every owned shard's
+    transfer/timer queues in conflict-keyed waves of at most that many
+    concurrent groups. 0 (the default) keeps the sequential pumps —
+    the gate is OFF unless a config opts in. ``matrixPath`` names the
+    commutativity-matrix artifact (``scripts/run_lint.sh`` regenerates
+    it); empty uses the live in-process footprint table. A stale or
+    missing artifact degrades loudly to sequential scheduling
+    (``parqueue_matrix_stale``)."""
+
+    parallelism: int = 0
+    batch_size: int = 64
+    poll_interval_ms: int = 50
+    matrix_path: str = ""
+
+    def validate(self) -> None:
+        if self.parallelism < 0:
+            raise ConfigError("queues.parallelism must be >= 0")
+        if self.batch_size <= 0:
+            raise ConfigError("queues.batchSize must be > 0")
+        if self.poll_interval_ms <= 0:
+            raise ConfigError("queues.pollIntervalMs must be > 0")
+
+    def build_executor(self, metrics=None):
+        """The ParallelQueueExecutor this section describes, or None
+        when the gate is off (sequential pumps)."""
+        if self.parallelism <= 0:
+            return None
+        from cadence_tpu.runtime.queues.parallel import (
+            ParallelQueueExecutor,
+        )
+
+        return ParallelQueueExecutor(
+            parallelism=self.parallelism,
+            batch_size=self.batch_size,
+            poll_interval_s=self.poll_interval_ms / 1000.0,
+            matrix_path=self.matrix_path or None,
+            metrics=metrics,
+        )
+
+
+@dataclasses.dataclass
 class CheckpointConfig:
     """Checkpointed incremental replay (cadence_tpu/checkpoint/).
 
@@ -513,6 +558,7 @@ class ServerConfig:
     autopilot: AutopilotConfig = dataclasses.field(
         default_factory=AutopilotConfig
     )
+    queues: QueuesConfig = dataclasses.field(default_factory=QueuesConfig)
     dynamicconfig_path: str = ""
     archival_dir: str = ""
 
@@ -526,6 +572,7 @@ class ServerConfig:
         self.replication.validate()
         self.telemetry.validate()
         self.autopilot.validate()
+        self.queues.validate()
         for name in self.services:
             if name not in SERVICES:
                 raise ConfigError(f"services: unknown service '{name}'")
@@ -700,6 +747,15 @@ def load_config_dict(raw: dict) -> ServerConfig:
             "freezeEpochs": "freeze_epochs",
             "backoffMaxSeconds": "backoff_max_s",
         }, "autopilot"))
+
+    q = raw.pop("queues", None)
+    if q:
+        cfg.queues = QueuesConfig(**_take(q, {
+            "parallelism": "parallelism",
+            "batchSize": "batch_size",
+            "pollIntervalMs": "poll_interval_ms",
+            "matrixPath": "matrix_path",
+        }, "queues"))
 
     dc = raw.pop("dynamicConfig", None)
     if dc:

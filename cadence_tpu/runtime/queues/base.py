@@ -228,6 +228,7 @@ class QueueProcessorBase:
         faults=None,
         exhausted_retry_delay_s: Optional[float] = None,
         shard_id: Optional[int] = None,
+        executor=None,
     ) -> None:
         self.name = name
         self.ack = ack
@@ -253,22 +254,42 @@ class QueueProcessorBase:
         # in-flight tasks run to completion — the drain-to-watermark
         # step of an ownership handoff
         self._paused = threading.Event()
-        self._pool = ThreadPoolExecutor(
-            max_workers=worker_count, thread_name_prefix=f"{name}-worker"
-        )
-        self._pump_thread = threading.Thread(
-            target=self._pump, name=f"{name}-pump", daemon=True
-        )
+        # executor mode (queues.parallelism > 0): the shared
+        # ParallelQueueExecutor owns the pump thread and worker pool —
+        # this processor only contributes collect/run hooks. notify()
+        # must NOT set self._notify in that mode: drain() reads it as
+        # "pump has pending work", and nothing would ever clear it.
+        self._executor = executor
+        if executor is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=worker_count,
+                thread_name_prefix=f"{name}-worker",
+            )
+            self._pump_thread = threading.Thread(
+                target=self._pump, name=f"{name}-pump", daemon=True
+            )
+        else:
+            self._pool = None
+            self._pump_thread = None
 
     def start(self) -> None:
+        if self._executor is not None:
+            self._executor.register(self)
+            return
         self._pump_thread.start()
 
     def notify(self) -> None:
+        if self._executor is not None:
+            self._executor.notify()
+            return
         self._notify.set()
 
     def stop(self) -> None:
         self._stopped.set()
         self._notify.set()
+        if self._executor is not None:
+            self._executor.unregister(self)
+            return
         self._pool.shutdown(wait=False)
 
     def drain(self, timeout_s: float = 5.0, *,
@@ -379,3 +400,32 @@ class QueueProcessorBase:
         except Exception:
             self._log.exception(f"queue {self.name} complete({key}) failed")
         self.ack.complete(key)
+
+    # -- parallel executor hooks ---------------------------------------
+
+    def parallel_collect(self, limit: int):
+        """Executor-mode batch read: one generation-stamped batch taken
+        through ``ack.add_batch`` but NOT executed — the shared
+        ParallelQueueExecutor schedules the returned ``(task, key)``
+        rows into conflict waves. Mirrors one ``_process_batch``
+        iteration (same rewind discipline: generation captured before
+        the read, cursor bump stamped with it)."""
+        if self._paused.is_set() or self._stopped.is_set():
+            return [], 0
+        gen = self.ack.generation()
+        batch = self._read_batch(self.ack.read_level, limit)
+        if not batch:
+            return [], gen
+        keys = [self._task_key(t) for t in batch]
+        taken = self.ack.add_batch(keys, generation=gen)
+        self.ack.set_read_level(keys[-1], generation=gen)
+        return (
+            [(t, k) for t, k, ok in zip(batch, keys, taken) if ok],
+            gen,
+        )
+
+    def parallel_run(self, task, key) -> None:
+        """Executor-mode execution of one collected task: the exact
+        sequential attempt path (trace span, timing, effect scope,
+        fault hook, retry/park, completion)."""
+        self._run_task(task, key)

@@ -48,6 +48,8 @@ same-workflow and distinct-workflow verdicts.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import threading
 from typing import Dict, FrozenSet, Iterable, Optional, Tuple
 
@@ -378,6 +380,30 @@ def record_persistence_call(manager: str, method: str) -> None:
 CONFLICT_MATRIX_SCHEMA = "queue_conflict_matrix"
 
 
+def footprints_fingerprint() -> str:
+    """Stable digest of the declared footprint table + surface scopes.
+
+    Embedded in the emitted conflict matrix and re-derived by the
+    parallel-queue executor at construction: a matrix artifact whose
+    fingerprint does not match the LIVE table was built against a
+    different footprint declaration and must not drive scheduling
+    (the executor degrades to sequential and counts
+    ``parqueue_matrix_stale``)."""
+    doc = {
+        "surfaces": dict(sorted(SURFACES.items())),
+        "footprints": {
+            f"{p}:{t}": {
+                "reads": sorted(f.reads),
+                "writes": sorted(f.writes),
+                "cross_workflow": sorted(f.cross_workflow),
+            }
+            for (p, t), f in sorted(TASK_FOOTPRINTS.items())
+        },
+    }
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
 def _conflicting_overlap(a: FrozenSet[str], b: FrozenSet[str]):
     """Shared surfaces whose scope does NOT make same-surface touches
     commute (counter increments and shared reads do)."""
@@ -458,4 +484,5 @@ def build_conflict_matrix() -> Dict[str, object]:
         "task_types": labels,
         "footprints": fps,
         "pairs": pairs,
+        "fingerprint": footprints_fingerprint(),
     }

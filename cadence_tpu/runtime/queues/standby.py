@@ -257,6 +257,7 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
         metrics=None,
         faults=None,
         exhausted_retry_delay_s=None,
+        executor=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -303,6 +304,7 @@ class TransferQueueStandbyProcessor(QueueProcessorBase):
             faults=faults,
             exhausted_retry_delay_s=exhausted_retry_delay_s,
             shard_id=shard.shard_id,
+            executor=executor,
         )
 
     # -- verification dispatch ----------------------------------------

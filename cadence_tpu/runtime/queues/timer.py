@@ -54,6 +54,7 @@ class TimerQueueProcessor:
         metrics=None,
         faults=None,
         exhausted_retry_delay_s=None,
+        executor=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -83,28 +84,47 @@ class TimerQueueProcessor:
         )
         self._stopped = threading.Event()
         self._paused = threading.Event()  # reshard fence: intake off
-        self._pool = ThreadPoolExecutor(
-            max_workers=worker_count, thread_name_prefix=f"timer-{shard.shard_id}"
-        )
         self._batch_size = batch_size
-        self._pump_thread = threading.Thread(
-            target=self._pump, name=f"timer-{shard.shard_id}-pump", daemon=True
-        )
+        # executor mode (queues.parallelism > 0): the shared
+        # ParallelQueueExecutor polls via parallel_collect; the gate,
+        # pool, and pump thread stay unused
+        self._executor = executor
+        if executor is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=worker_count,
+                thread_name_prefix=f"timer-{shard.shard_id}",
+            )
+            self._pump_thread = threading.Thread(
+                target=self._pump, name=f"timer-{shard.shard_id}-pump",
+                daemon=True,
+            )
+        else:
+            self._pool = None
+            self._pump_thread = None
 
     def _drop_resume(self) -> None:
         self._resume.drop()
         self.gate.update(0)
 
     def start(self) -> None:
+        if self._executor is not None:
+            self._executor.register(self)
+            return
         self._pump_thread.start()
 
     def notify(self) -> None:
+        if self._executor is not None:
+            self._executor.notify()
+            return
         # a new timer may be earlier than anything armed: wake now
         self.gate.update(0)
 
     def stop(self) -> None:
         self._stopped.set()
         self.gate.update(0)
+        if self._executor is not None:
+            self._executor.unregister(self)
+            return
         self._pool.shutdown(wait=False)
 
     def drain(self, timeout_s: float = 5.0, *, deadline=None) -> bool:
@@ -195,6 +215,40 @@ class TimerQueueProcessor:
         )
         if future:
             self.gate.update(future[0].visibility_timestamp)
+
+    # -- parallel executor hooks ---------------------------------------
+
+    def parallel_collect(self, limit: int):
+        """Executor-mode due-window read: the ``_process_due`` scan with
+        collection instead of pool submission. Offers are stamped with
+        the ack generation so a rewind between this collect and the wave
+        execution rejects them (the sequential timer pump relies on the
+        resume-cursor drop for the same property; the executor checks
+        the generation explicitly before running the wave). No gate
+        arming — the executor polls on its own interval."""
+        if self._paused.is_set() or self._stopped.is_set():
+            return [], 0
+        now = self.shard.now()
+        key, gen = self._resume.begin()
+        agen = self.ack.generation()
+        min_ts = self.ack.ack_level[0]
+        out = []
+
+        def offer(task, k):
+            if self.ack.add(k, generation=agen):
+                out.append((task, k))
+
+        self._resume.store_if_current(
+            read_due_timers(
+                self.shard.persistence.execution, self.shard.shard_id,
+                min_ts, now + 1, min(limit, self._batch_size), key, offer,
+            ),
+            gen,
+        )
+        return out, agen
+
+    def parallel_run(self, task, key) -> None:
+        self._run_task(task, key)
 
     _TASK_RETRY_COUNT = 3
 

@@ -75,6 +75,7 @@ class TransferQueueProcessor(QueueProcessorBase):
         metrics=None,
         faults=None,
         exhausted_retry_delay_s=None,
+        executor=None,
     ) -> None:
         self.shard = shard
         self.engine = engine
@@ -123,6 +124,7 @@ class TransferQueueProcessor(QueueProcessorBase):
             faults=faults,
             exhausted_retry_delay_s=exhausted_retry_delay_s,
             shard_id=shard.shard_id,
+            executor=executor,
         )
 
     # -- dispatch ------------------------------------------------------

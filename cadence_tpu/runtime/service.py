@@ -48,6 +48,7 @@ class HistoryService:
         checkpoints=None,
         serving=None,
         rate_limiter=None,
+        queue_executor=None,
     ) -> None:
         from cadence_tpu.utils.metrics import Scope
 
@@ -88,6 +89,11 @@ class HistoryService:
         # the retryable ServiceBusyError + retry-after. None = never
         # shed at this layer (the frontend's limiter still applies)
         self.rate_limiter = rate_limiter
+        # queues.ParallelQueueExecutor (config `queues.parallelism`):
+        # the shared conflict-keyed wave scheduler every owned shard's
+        # transfer/timer pumps register with. None (the default) keeps
+        # the sequential per-queue pump threads.
+        self.queue_executor = queue_executor
         # the serving tick pump (serving/pump.py), started when the
         # engine carries a configured cadence (serving.tickIntervalMs)
         self._tick_pump = None
@@ -139,6 +145,12 @@ class HistoryService:
     def start(self) -> None:
         if self.matching_client is None or self.history_client is None:
             raise RuntimeError("HistoryService.wire() must be called first")
+        if self.queue_executor is not None:
+            # before acquire_shards: _build_shard registers each shard's
+            # pumps with the executor, which must already be pumping
+            # (start() is idempotent — a shared executor across services
+            # starts once)
+            self.queue_executor.start()
         self.controller.acquire_shards()
         if (self.serving is not None
                 and getattr(self.serving, "tick_interval_s", 0) > 0):
@@ -171,6 +183,8 @@ class HistoryService:
             # boot's admissions resume suffix-only)
             self.serving.drain()
         self.controller.stop()
+        if self.queue_executor is not None:
+            self.queue_executor.stop()
 
     # -- per-shard assembly --------------------------------------------
 
@@ -196,6 +210,7 @@ class HistoryService:
             metrics=self.metrics,
             faults=self.faults,
             exhausted_retry_delay_s=self._queue_park_delay_s,
+            executor=self.queue_executor,
         )
         timer = TimerQueueProcessor(
             shard, engine, matching=self.matching_client,
@@ -204,6 +219,7 @@ class HistoryService:
             metrics=self.metrics,
             faults=self.faults,
             exhausted_retry_delay_s=self._queue_park_delay_s,
+            executor=self.queue_executor,
         )
         processors = [transfer, timer]
         notifiers = [transfer.notify]

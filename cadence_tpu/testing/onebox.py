@@ -45,6 +45,7 @@ class Onebox:
         serving=None,
         sanitize: bool = False,
         autopilot=None,
+        queue_parallel: int = 0,
     ) -> None:
         self.faults = faults
         self.persistence = persistence or create_memory_bundle()
@@ -98,6 +99,20 @@ class Onebox:
                 metrics=self.metrics,
             )
         self.serving = serving or None
+        # queue_parallel > 0: the shared conflict-keyed wave executor
+        # (queues.parallelism gate) over this box's transfer/timer
+        # pumps. Built from the live footprint table (matrix=None →
+        # ConflictMatrix.live()), so it is fresh by construction and
+        # never degrades in-process.
+        self.queue_executor = None
+        if queue_parallel:
+            from cadence_tpu.runtime.queues.parallel import (
+                ParallelQueueExecutor,
+            )
+
+            self.queue_executor = ParallelQueueExecutor(
+                parallelism=queue_parallel, metrics=self.metrics
+            )
         self.history = HistoryService(
             num_shards, self.persistence, self.domains, self.monitor,
             cluster_metadata=self.cluster_metadata,
@@ -107,6 +122,7 @@ class Onebox:
             time_source=time_source,
             checkpoints=self.checkpoints,
             serving=self.serving,
+            queue_executor=self.queue_executor,
         )
         self.history_client = HistoryClient(
             self.history.controller, metrics=self.metrics

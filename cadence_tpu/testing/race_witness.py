@@ -95,6 +95,11 @@ GUARDED_FIELDS: Dict[str, str] = {
     # and read by the admin status/pause verbs
     "CapacityController._rates": "CapacityController._lock",
     "CapacityController._cooldowns": "CapacityController._lock",
+    # parallel queue executor (runtime/queues/parallel.py): the slot
+    # table is written by register/unregister (service threads) and
+    # snapshotted by the pump; the lock is NEVER held across queue
+    # collect/run calls, so the executor adds no lock-graph edges
+    "ParallelQueueExecutor._slots": "ParallelQueueExecutor._lock",
 }
 
 

@@ -93,6 +93,28 @@ QUEUE_METRICS = (
     "task_requests", "task_latency", "task_errors", "task_outstanding",
     "task_held",
 )
+# Parallel queue executor (runtime/queues/parallel.py), scope tagged
+# queue="parallel". parqueue_cycles / parqueue_tasks / parqueue_waves
+# count pump cycles, tasks collected, and conflict groups executed;
+# parqueue_wave_width records groups-per-cycle (the concurrency the
+# matrix actually unlocked) and parqueue_conflict_frac the fraction of
+# a cycle's tasks that conflicted into shared groups (1 - waves/tasks);
+# parqueue_cycle_latency times one collect→schedule→execute round.
+# parqueue_queues gauges registered pumps. The failure plane:
+# parqueue_matrix_stale counts a commutativity-matrix artifact rejected
+# at construction (version/fingerprint mismatch vs the live footprint
+# table) with parqueue_degraded gauging the resulting sequential-only
+# mode (1 = degraded — alert on it; the executor WARNS but will not
+# resume parallel waves until rebuilt against a fresh artifact), and
+# parqueue_stale_skipped counts tasks rejected wave-whole because their
+# queue's ack generation moved (rewind/fence) between collect and run.
+PARQUEUE_METRICS = (
+    "parqueue_cycles", "parqueue_tasks", "parqueue_waves",
+    "parqueue_wave_width", "parqueue_conflict_frac",
+    "parqueue_cycle_latency", "parqueue_queues",
+    "parqueue_matrix_stale", "parqueue_degraded",
+    "parqueue_stale_skipped",
+)
 # Adaptive geo-replication (runtime/replication/transport.py) extends
 # the consumer side: replication_lag_events / replication_lag_seconds
 # gauge how far the standby's APPLIED STATE trails the source (events

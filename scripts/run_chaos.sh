@@ -48,6 +48,15 @@
 #       # bounded, and the tick pump holds serving_staleness_ms p99
 #       # under the configured bound
 #
+#   CHAOS_PARQUEUE=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
+#       # parallel-queue sweep (TestParallelQueueChaos): the conflict-
+#       # keyed wave executor draining the same topology as the
+#       # sequential pump under the >=10% write-fault storm — every
+#       # seed re-proves byte-identical workflow histories across the
+#       # two drain modes, with the effect witness asserting recorded
+#       # ⊆ declared for every wave (the commutativity matrix
+#       # validated under execution)
+#
 #   CHAOS_AUTOPILOT=1 CHAOS_SEEDS="1 7 42 99" scripts/run_chaos.sh
 #       # capacity-autopilot sweep (TestAutopilotChaos): the closed
 #       # sense->decide->actuate loop under chaos — a diurnal sweep
@@ -85,6 +94,9 @@ if [[ -n "${CHAOS_OVERLOAD:-}" ]]; then
 fi
 if [[ -n "${CHAOS_AUTOPILOT:-}" ]]; then
     FILTER=(-k TestAutopilotChaos)
+fi
+if [[ -n "${CHAOS_PARQUEUE:-}" ]]; then
+    FILTER=(-k TestParallelQueueChaos)
 fi
 
 run_one() {

@@ -12,12 +12,17 @@
 # Runs on CPU (the kernel is traced, not executed); non-zero exit on
 # any finding not in config/lint_baseline.json, and — via
 # --strict-stale — on any baseline entry matching nothing, so dead
-# entries can't accumulate silently. Also emits the queue-task
-# commutativity matrix artifact (the parallel-queue executor's gate)
-# under build/, versioned via the shared schema_version envelope.
-# Tier-1 covers the same gate in-process via
-# tests/test_static_analysis.py; this wrapper is the standalone/CI
-# entry.
+# entries can't accumulate silently. Also REGENERATES the queue-task
+# commutativity matrix artifact build/queue_conflict_matrix.json on
+# every run (versioned via the shared schema_version envelope, with
+# the live footprint-table fingerprint embedded) — the artifact the
+# ParallelQueueExecutor (queues.parallelism) consumes at construction.
+# The emit runs before the baseline gate in cadence_tpu.analysis, so
+# new findings never leave a stale matrix behind; a consumer that
+# still sees a fingerprint mismatch degrades loudly to sequential
+# (parqueue_matrix_stale + warning), never silently. Tier-1 covers the
+# same gate in-process via tests/test_static_analysis.py; this wrapper
+# is the standalone/CI entry.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -227,6 +227,35 @@ def test_smoke_emits_one_json_record():
     assert dr["guardrail_freezes"] == 0, dr
     assert dr["operator_calls"] == 0, dr
     assert dr["drain_flush_failed"] == 0, dr
+    # the parallel-queue-drain contract (ISSUE 20): both drain arms run
+    # the identical mixed transfer/timer storm to completion, the
+    # commutative final state matches byte-for-byte, the wave executor
+    # schedules through a FRESH conflict-matrix artifact (a degraded
+    # gate would silently bench sequential-vs-sequential), and the wave
+    # observables (width / conflict_frac) land in the record. The >=2x
+    # speedup bar binds on real runs — at smoke scale and on a loaded
+    # single-core host the ratio is scheduling noise, so only
+    # directionality (speedup > 0) is pinned here
+    qd = out["configs"]["queue_drain"]
+    for key in ("tasks", "queues", "parallelism", "seq_tasks_per_sec",
+                "par_tasks_per_sec", "speedup", "wave_width_mean",
+                "conflict_frac", "cycles", "stale_skipped", "degraded",
+                "drained", "state_identical"):
+        assert key in qd, f"queue_drain lacks {key}"
+    assert qd["drained"] is True, qd
+    assert qd["state_identical"] is True, (
+        "parallel drain diverged from the sequential drain", qd,
+    )
+    assert qd["degraded"] is False, (
+        "wave executor degraded: conflict-matrix artifact stale", qd,
+    )
+    assert qd["seq_tasks_per_sec"] > 0 and qd["par_tasks_per_sec"] > 0
+    assert qd["speedup"] > 0, qd
+    assert qd["wave_width_mean"] > 1.0, (
+        "no cycle ever split into concurrent conflict groups", qd,
+    )
+    assert 0.0 <= qd["conflict_frac"] < 1.0, qd
+    assert qd["cycles"] > 0, qd
 
 
 def test_watchdog_still_yields_parseable_record():
@@ -284,6 +313,15 @@ def test_serve_continuous_degrades_to_cpu_fallback_record():
     assert dr["rate_tracks_load"] is True, dr
     assert dr["guardrail_freezes"] == 0, dr
     assert dr["operator_calls"] == 0, dr
+    # the queue-drain config's CPU-fallback degrade pin: the wave
+    # executor is a host-side plane (no kernels), so the flagged
+    # fallback record still carries a full non-degraded, state-equal
+    # drain — never a crash, never a missing config
+    qd = out["configs"]["queue_drain"]
+    assert qd["drained"] is True, qd
+    assert qd["state_identical"] is True, qd
+    assert qd["degraded"] is False, qd
+    assert qd["par_tasks_per_sec"] > 0, qd
 
 
 @pytest.mark.slow

@@ -450,9 +450,14 @@ def _drive_sanitized_box(num_workflows=2):
         # the guarded-field assertion needs)
         from cadence_tpu.config.static import AutopilotConfig
 
+        # queue_parallel=2: the acceptance drive boots with the
+        # conflict-keyed wave executor enabled, so its guarded slot
+        # table registers and its (lock-free-during-queue-calls)
+        # execution path runs under the sanitizer with real traffic
         box = Onebox(
             num_shards=2, sanitize=True, checkpoints=True, serving=True,
             autopilot=AutopilotConfig(enabled=True, epoch_interval_s=3600),
+            queue_parallel=2,
         ).start()
         try:
             box.domain_handler.register_domain("san-dom")
